@@ -1,0 +1,38 @@
+"""lodestar-tpu CLI entry point.
+
+Mirrors the reference's command set (cli/src/cmds: beacon, validator,
+lightclient, dev); commands are registered as subsystems land.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="lodestar",
+        description="TPU-native Ethereum consensus client",
+    )
+    sub = parser.add_subparsers(dest="command")
+    sub.add_parser("version", help="print version and exit")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.command == "version":
+        from importlib.metadata import PackageNotFoundError, version
+
+        try:
+            print(version("lodestar-tpu"))
+        except PackageNotFoundError:
+            print("0.1.0 (uninstalled tree)")
+        return 0
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""BLS12-381 for Ethereum consensus — CPU oracle implementation.
+
+The TPU-accelerated engine lives in ``lodestar_tpu.ops``; this package is the
+from-scratch pure-Python reference used as its differential-testing oracle and
+as the host-side fallback verifier (the role herumi/bls-eth-wasm plays in the
+reference client, chain/bls/multithread/index.ts:123-126).
+"""
+from .api import (
+    BlsError,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    aggregate_public_keys,
+    aggregate_signatures,
+    aggregate_verify,
+    fast_aggregate_verify,
+    verify,
+    verify_multiple_signature_sets,
+    verify_signature_set,
+)
+
+__all__ = [
+    "BlsError",
+    "PublicKey",
+    "SecretKey",
+    "Signature",
+    "SignatureSet",
+    "aggregate_public_keys",
+    "aggregate_signatures",
+    "aggregate_verify",
+    "fast_aggregate_verify",
+    "verify",
+    "verify_multiple_signature_sets",
+    "verify_signature_set",
+]

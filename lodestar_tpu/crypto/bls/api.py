@@ -1,0 +1,235 @@
+"""Ethereum BLS signature API (CPU oracle backend).
+
+Mirrors the surface of ``@chainsafe/bls`` that the reference client consumes:
+SecretKey/PublicKey/Signature objects, aggregate, verify, fastAggregateVerify,
+aggregateVerify, and verifyMultipleSignatures (the random-linear-combination
+batch verification of chain/bls/maybeBatch.ts:17).
+
+Scheme: minimal-pubkey-size (pubkeys in G1/48B, signatures in G2/96B), POP
+ciphersuite — the Ethereum consensus configuration.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from . import curve, pairing
+from .curve import (
+    AffineG1,
+    AffineG2,
+    G1_GEN_JAC,
+    g1,
+    g2,
+    g1_from_bytes,
+    g1_in_subgroup,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_in_subgroup,
+    g2_to_bytes,
+)
+from .fields import R
+from .hash_to_curve import hash_to_g2
+
+_NEG_G1_GEN = g1.neg_pt(G1_GEN_JAC)
+_NEG_G1_GEN_AFF = g1.to_affine(_NEG_G1_GEN)
+
+# Batch-verification random coefficients are 64-bit like the reference's blst
+# randomness (sufficient for 2^-64 soundness per set).
+RAND_BITS = 64
+
+
+class BlsError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class SecretKey:
+    value: int
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SecretKey":
+        if len(data) != 32:
+            raise BlsError("secret key must be 32 bytes")
+        v = int.from_bytes(data, "big")
+        if not 0 < v < R:
+            raise BlsError("secret key out of range")
+        return cls(v)
+
+    @classmethod
+    def key_gen(cls, ikm: bytes, key_info: bytes = b"") -> "SecretKey":
+        """EIP-2333-compatible HKDF keygen (draft-irtf-cfrg-bls-signature KeyGen)."""
+        salt = b"BLS-SIG-KEYGEN-SALT-"
+        sk = 0
+        while sk == 0:
+            salt = hashlib.sha256(salt).digest()
+            prk = hmac.new(salt, ikm + b"\x00", hashlib.sha256).digest()
+            okm = b""
+            t = b""
+            info = key_info + (48).to_bytes(2, "big")
+            i = 1
+            while len(okm) < 48:
+                t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+                okm += t
+                i += 1
+            sk = int.from_bytes(okm[:48], "big") % R
+        return cls(sk)
+
+    @classmethod
+    def generate(cls) -> "SecretKey":
+        return cls.key_gen(os.urandom(32))
+
+    def to_bytes(self) -> bytes:
+        return self.value.to_bytes(32, "big")
+
+    def to_public_key(self) -> "PublicKey":
+        return PublicKey(g1.to_affine(g1.mul_scalar(G1_GEN_JAC, self.value)))
+
+    def sign(self, message: bytes) -> "Signature":
+        h = hash_to_g2(message)
+        return Signature(g2.to_affine(g2.mul_scalar(h, self.value)))
+
+
+@dataclass(frozen=True)
+class PublicKey:
+    point: AffineG1  # None == identity (invalid for Ethereum key-validate)
+
+    @classmethod
+    def from_bytes(cls, data: bytes, validate: bool = True) -> "PublicKey":
+        pt = g1_from_bytes(data)
+        if validate:
+            if pt is None:
+                raise BlsError("infinity pubkey rejected (KeyValidate)")
+            if not g1_in_subgroup(g1.from_affine(pt)):
+                raise BlsError("pubkey not in G1 subgroup")
+        return cls(pt)
+
+    def to_bytes(self, compressed: bool = True) -> bytes:
+        return g1_to_bytes(self.point, compressed)
+
+    def __bytes__(self) -> bytes:
+        return self.to_bytes()
+
+
+@dataclass(frozen=True)
+class Signature:
+    point: AffineG2
+
+    @classmethod
+    def from_bytes(cls, data: bytes, validate: bool = True) -> "Signature":
+        pt = g2_from_bytes(data)
+        if validate and pt is not None and not g2_in_subgroup(g2.from_affine(pt)):
+            raise BlsError("signature not in G2 subgroup")
+        return cls(pt)
+
+    def to_bytes(self, compressed: bool = True) -> bytes:
+        return g2_to_bytes(self.point, compressed)
+
+    def __bytes__(self) -> bytes:
+        return self.to_bytes()
+
+
+def aggregate_public_keys(pks: Sequence[PublicKey]) -> PublicKey:
+    """Jacobian-coordinate pubkey aggregation (reference: chain/bls/utils.ts:5)."""
+    acc = curve.INF_G1
+    for pk in pks:
+        acc = g1.add_pts(acc, g1.from_affine(pk.point))
+    return PublicKey(g1.to_affine(acc))
+
+
+def aggregate_signatures(sigs: Sequence[Signature]) -> Signature:
+    acc = curve.INF_G2
+    for s in sigs:
+        acc = g2.add_pts(acc, g2.from_affine(s.point))
+    return Signature(g2.to_affine(acc))
+
+
+def verify(pk: PublicKey, message: bytes, sig: Signature) -> bool:
+    """CoreVerify: e(pk, H(m)) * e(-G1, sig) == 1."""
+    if pk.point is None or sig.point is None:
+        return False
+    if not g2_in_subgroup(g2.from_affine(sig.point)):
+        return False
+    h = g2.to_affine(hash_to_g2(message))
+    return pairing.multi_pairing_is_one(
+        [(pk.point, h), (_NEG_G1_GEN_AFF, sig.point)]
+    )
+
+
+def fast_aggregate_verify(pks: Sequence[PublicKey], message: bytes, sig: Signature) -> bool:
+    if not pks:
+        return False
+    agg = aggregate_public_keys(pks)
+    if agg.point is None:
+        return False
+    return verify(agg, message, sig)
+
+
+def eth_fast_aggregate_verify(pks: Sequence[PublicKey], message: bytes, sig: Signature) -> bool:
+    """Ethereum consensus wrapper: accepts (no pubkeys, infinity signature) as
+    valid — the empty-sync-aggregate case (consensus-specs eth_fast_aggregate_verify)."""
+    if not pks and sig.point is None:
+        return True
+    return fast_aggregate_verify(pks, message, sig)
+
+
+def aggregate_verify(pks: Sequence[PublicKey], messages: Sequence[bytes], sig: Signature) -> bool:
+    if not pks or len(pks) != len(messages) or sig.point is None:
+        return False
+    if any(pk.point is None for pk in pks):
+        return False
+    if not g2_in_subgroup(g2.from_affine(sig.point)):
+        return False
+    pairs: List[Tuple[AffineG1, AffineG2]] = [
+        (pk.point, g2.to_affine(hash_to_g2(m))) for pk, m in zip(pks, messages)
+    ]
+    pairs.append((_NEG_G1_GEN_AFF, sig.point))
+    return pairing.multi_pairing_is_one(pairs)
+
+
+@dataclass(frozen=True)
+class SignatureSet:
+    """One verification task: (pubkey, message, signature) — the same triple
+    as the reference's ISignatureSet (state-transition/src/util/signatureSets.ts:10)
+    after pubkey aggregation."""
+
+    public_key: PublicKey
+    message: bytes
+    signature: Signature
+
+
+def verify_signature_set(s: SignatureSet) -> bool:
+    return verify(s.public_key, s.message, s.signature)
+
+
+def verify_multiple_signature_sets(
+    sets: Sequence[SignatureSet], rand: Optional[Sequence[int]] = None
+) -> bool:
+    """Batch verification with random linear combination (blst's
+    verifyMultipleSignatures; reference chain/bls/maybeBatch.ts:17).
+
+    prod_i [ e(pk_i, r_i H(m_i)) * e(-G1, r_i sig_i) ] == 1
+    realised as  prod_i e(r_i pk_i, H(m_i)) * e(-G1, sum_i r_i sig_i) == 1
+    so the n+1 Miller loops share one final exponentiation.
+    """
+    if not sets:
+        return False
+    if rand is None:
+        rand = [int.from_bytes(os.urandom(8), "big") | 1 for _ in sets]
+    elif len(rand) != len(sets):
+        raise BlsError("rand coefficient count must match set count")
+    pairs: List[Tuple[AffineG1, AffineG2]] = []
+    sig_acc = curve.INF_G2
+    for s, r in zip(sets, rand):
+        if s.public_key.point is None or s.signature.point is None:
+            return False
+        if not g2_in_subgroup(g2.from_affine(s.signature.point)):
+            return False
+        h = g2.to_affine(hash_to_g2(s.message))
+        rpk = g1.to_affine(g1.mul_scalar(g1.from_affine(s.public_key.point), r))
+        pairs.append((rpk, h))
+        sig_acc = g2.add_pts(sig_acc, g2.mul_scalar(g2.from_affine(s.signature.point), r))
+    pairs.append((_NEG_G1_GEN_AFF, g2.to_affine(sig_acc)))
+    return pairing.multi_pairing_is_one(pairs)
