@@ -14,6 +14,7 @@ from .api import (
     aggregate_public_keys,
     aggregate_signatures,
     aggregate_verify,
+    eth_fast_aggregate_verify,
     fast_aggregate_verify,
     verify,
     verify_multiple_signature_sets,
@@ -29,6 +30,7 @@ __all__ = [
     "aggregate_public_keys",
     "aggregate_signatures",
     "aggregate_verify",
+    "eth_fast_aggregate_verify",
     "fast_aggregate_verify",
     "verify",
     "verify_multiple_signature_sets",

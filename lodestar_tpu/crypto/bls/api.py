@@ -217,7 +217,7 @@ def verify_multiple_signature_sets(
     if not sets:
         return False
     if rand is None:
-        rand = [int.from_bytes(os.urandom(8), "big") | 1 for _ in sets]
+        rand = [int.from_bytes(os.urandom(RAND_BITS // 8), "big") | 1 for _ in sets]
     elif len(rand) != len(sets):
         raise BlsError("rand coefficient count must match set count")
     pairs: List[Tuple[AffineG1, AffineG2]] = []

@@ -13,7 +13,6 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 from .fields import (
-    ABS_X,
     P,
     R,
     X,
@@ -25,7 +24,6 @@ from .fields import (
     f2_inv,
     f2_is_zero,
     f2_mul,
-    f2_mul_scalar,
     f2_neg,
     f2_pow,
     f2_sqr,

@@ -27,9 +27,7 @@ from .fields import (
     f2_inv,
     f2_is_zero,
     f2_mul,
-    f2_mul_scalar,
     f2_neg,
-    f2_pow,
     f2_sgn0,
     f2_sqr,
     f2_sqrt,
@@ -121,12 +119,6 @@ def hash_to_field_fp2(msg: bytes, count: int, dst: bytes = CIPHERSUITE_DST) -> L
 # ---------------------------------------------------------------------------
 
 
-def _is_square_fp2(a: Fp2T) -> bool:
-    if f2_is_zero(a):
-        return True
-    return f2_pow(a, (P * P - 1) // 2) == F2_ONE
-
-
 def map_to_curve_sswu(t: Fp2T) -> Tuple[Fp2T, Fp2T]:
     """Non-constant-time simplified SWU; returns a point on E''."""
     zt2 = f2_mul(SSWU_Z, f2_sqr(t))          # Z t^2
@@ -139,8 +131,9 @@ def map_to_curve_sswu(t: Fp2T) -> Tuple[Fp2T, Fp2T]:
             f2_add(F2_ONE, f2_inv(tv1)),
         )
     gx1 = f2_add(f2_mul(f2_add(f2_sqr(x1), SSWU_A), x1), SSWU_B)
-    if _is_square_fp2(gx1):
-        x, y = x1, f2_sqrt(gx1)
+    y = f2_sqrt(gx1)
+    if y is not None:
+        x = x1
     else:
         x2 = f2_mul(zt2, x1)
         gx2 = f2_add(f2_mul(f2_add(f2_sqr(x2), SSWU_A), x2), SSWU_B)

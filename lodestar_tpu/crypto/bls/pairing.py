@@ -18,6 +18,7 @@ from typing import Iterable, Sequence, Tuple
 from .curve import AffineG1, AffineG2, g1, g2
 from .fields import (
     ABS_X,
+    f12_cyclotomic_pow_x,
     F6_ONE,
     F6_ZERO,
     F12_ONE,
@@ -32,7 +33,6 @@ from .fields import (
     f12_pow,
     f12_sqr,
     f12_sub,
-    f6_sub,
 )
 
 # Fp12 constants for the untwist map: w, w^-2, w^-3  (w^2 = v).
@@ -114,12 +114,43 @@ def miller_loop(q: AffineG2, p: AffineG1) -> Fp12T:
     return f12_conj(f)
 
 
+def _pow_neg_x(a: Fp12T) -> Fp12T:
+    """a^x for the (negative) BLS parameter x, for cyclotomic-subgroup a.
+
+    a^x = conj(a^|x|) since inversion is conjugation in the cyclotomic
+    subgroup.
+    """
+    return f12_conj(f12_cyclotomic_pow_x(a))
+
+
+def hard_part_x_chain(m: Fp12T) -> Fp12T:
+    """m^(3*(p^4 - p^2 + 1)/r) via the x-adic chain (5 pow-by-x).
+
+    Uses 3*(p^4-p^2+1)/r = (x-1)^2 (x+p)(x^2+p^2-1) + 3 — the standard
+    BLS12 hard-part decomposition.  The spurious cube is harmless for
+    pairing equality checks because gcd(3, r) = 1; the TPU engine
+    (lodestar_tpu/ops) implements the identical chain so the two engines
+    agree bit-for-bit.  Validated against the direct integer exponent in
+    tests/test_bls_oracle.py.
+    """
+    # t1 = m^((x-1)^2):  m^(x-1) = conj(m^|x| * m)  (x < 0)
+    t0 = f12_conj(f12_mul(f12_cyclotomic_pow_x(m), m))
+    t1 = f12_conj(f12_mul(f12_cyclotomic_pow_x(t0), t0))
+    # a = t1^(x+p)
+    a = f12_mul(_pow_neg_x(t1), f12_frobenius(t1, 1))
+    # t4 = a^(x^2+p^2-1) = (a^x)^x * a^(p^2) * conj(a)
+    b = _pow_neg_x(a)
+    t4 = f12_mul(f12_mul(_pow_neg_x(b), f12_frobenius(a, 2)), f12_conj(a))
+    # * m^3
+    return f12_mul(t4, f12_mul(f12_sqr(m), m))
+
+
 def final_exponentiation(f: Fp12T) -> Fp12T:
     # easy part: f^((p^6 - 1)(p^2 + 1))
     f1 = f12_mul(f12_conj(f), f12_inv(f))          # f^(p^6 - 1)
     f2 = f12_mul(f12_frobenius(f1, 2), f1)         # ^(p^2 + 1)
-    # hard part: ^((p^4 - p^2 + 1)/r)
-    return f12_pow(f2, _HARD_EXP)
+    # hard part (times 3, see hard_part_x_chain)
+    return hard_part_x_chain(f2)
 
 
 def pairing(p: AffineG1, q: AffineG2) -> Fp12T:

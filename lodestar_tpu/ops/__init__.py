@@ -1,0 +1,8 @@
+"""TPU-native compute kernels (JAX/XLA/Pallas) for the lodestar-tpu framework.
+
+This package is the device-side counterpart of the pure-Python oracle in
+``lodestar_tpu.crypto``: the hot math (BLS12-381 pairings for signature
+verification — the role blst plays in the reference client, consumed at
+packages/beacon-node/src/chain/bls/maybeBatch.ts:17) runs here as batched,
+jit-compiled JAX programs designed for the TPU's VPU/MXU and ICI collectives.
+"""

@@ -1,0 +1,8 @@
+"""BLS12-381 on TPU: limb-vector field arithmetic, towers, curves, pairing.
+
+Layout: an Fp element is a uint32 tensor whose trailing axis holds
+``NLIMBS`` radix-``2**LIMB_BITS`` limbs in Montgomery form.  All operations
+broadcast over arbitrary leading batch axes, so "vmap" over a signature batch
+is just array layout — the natural TPU mapping of the reference's
+data-parallel BLS worker pool (chain/bls/multithread/index.ts:98).
+"""

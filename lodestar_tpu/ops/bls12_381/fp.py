@@ -1,0 +1,227 @@
+"""Fp arithmetic over limb tensors — the innermost TPU kernel layer.
+
+Every value is a uint32 tensor ``(..., NLIMBS)`` of radix-2^13 limbs in
+Montgomery form, canonical (each limb < 2^13, value < p).  Ops broadcast over
+leading axes, so a batch of field elements is just a leading dimension — the
+TPU-native analogue of the reference's per-core BLS worker data parallelism
+(packages/beacon-node/src/chain/bls/multithread/index.ts:98).
+
+Sequential structure (carry chains, CIOS) is expressed as ``lax.scan`` over
+the limb axis so XLA traces a single step regardless of batch size.
+
+Overflow audit for mont_mul (uint32, b = 2^13-1 = 8191):
+  * product a_i*b_j <= 8191^2 = 67,092,481 < 2^27
+  * a column receives at most NLIMBS products from a*b and NLIMBS from m*p:
+    2*30*8191^2 = 4,025,548,860, plus one shift carry < 2^20
+    -> max 4,026,597,309 < 2^32 - 1.   No wraparound.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .limbs import LIMB_BITS, MASK, NLIMBS, N0INV, ONE_MONT, P_LIMBS, R2_LIMBS
+
+_u32 = jnp.uint32
+
+# Device-constant views of host numpy constants (closed over inside jit).
+_P = jnp.asarray(P_LIMBS, dtype=_u32)
+_R2 = jnp.asarray(R2_LIMBS, dtype=_u32)
+_ONE_M = jnp.asarray(ONE_MONT, dtype=_u32)
+
+
+def zeros(shape=()) -> jnp.ndarray:
+    return jnp.zeros((*shape, NLIMBS), dtype=_u32)
+
+
+def one_mont(shape=()) -> jnp.ndarray:
+    return jnp.broadcast_to(_ONE_M, (*shape, NLIMBS))
+
+
+# ---------------------------------------------------------------------------
+# carry / borrow primitives
+# ---------------------------------------------------------------------------
+
+
+def _carry_once(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry pass; exact iff each limb < 2^14 and value < 2^390.
+
+    For limbs <= 2*MASK (a single addition of canonical values) the result is
+    fully canonical: (2*MASK & MASK) = MASK-1, +carry(<=1) <= MASK.
+    """
+    low = x & MASK
+    carry = x >> LIMB_BITS
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1
+    )
+    return low + shifted
+
+
+def _carry_scan(x: jnp.ndarray) -> jnp.ndarray:
+    """Full normalization for limbs up to 2^32: sequential carry scan.
+
+    Drops the final carry (caller guarantees value < 2^390).
+    """
+    xs = jnp.moveaxis(x, -1, 0)
+
+    def body(carry, xi):
+        cur = xi + carry
+        return cur >> LIMB_BITS, cur & MASK
+
+    _, ys = jax.lax.scan(body, jnp.zeros_like(xs[0]), xs)
+    return jnp.moveaxis(ys, 0, -1)
+
+
+def _borrow_sub(a: jnp.ndarray, b: jnp.ndarray):
+    """(a - b) mod 2^390 with canonical inputs; returns (limbs, borrow_flag).
+
+    borrow_flag (uint32 0/1) is 1 iff a < b.
+    """
+    a_s = jnp.moveaxis(a, -1, 0)
+    b_s = jnp.moveaxis(jnp.broadcast_to(b, a.shape), -1, 0)
+
+    def body(borrow, ab):
+        ai, bi = ab
+        t = ai + _u32(1 << LIMB_BITS) - bi - borrow
+        return _u32(1) - (t >> LIMB_BITS), t & MASK
+
+    borrow, ys = jax.lax.scan(body, jnp.zeros_like(a_s[0]), (a_s, b_s))
+    return jnp.moveaxis(ys, 0, -1), borrow
+
+
+def _cond_sub_p(t: jnp.ndarray) -> jnp.ndarray:
+    """Canonicalize t in [0, 2p) -> [0, p)."""
+    d, borrow = _borrow_sub(t, _P)
+    return jnp.where((borrow != 0)[..., None], t, d)
+
+
+# ---------------------------------------------------------------------------
+# ring ops
+# ---------------------------------------------------------------------------
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _cond_sub_p(_carry_once(a + b))
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    a, b = jnp.broadcast_arrays(a, b)
+    d, borrow = _borrow_sub(a, b)
+    # If a < b the limbs represent a-b+2^390; adding p and dropping the top
+    # carry (which is exactly 2^390 here) yields a-b+p in [0, p).
+    dp = _carry_once(d + _P)
+    return jnp.where((borrow != 0)[..., None], dp, d)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return sub(jnp.zeros_like(a), a)
+
+
+def dbl(a: jnp.ndarray) -> jnp.ndarray:
+    return add(a, a)
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product a*b*R^{-1} mod p, canonical output.
+
+    CIOS over a's limbs as a lax.scan: one traced step regardless of batch.
+    """
+    a, b = jnp.broadcast_arrays(a, b)
+    a_s = jnp.moveaxis(a, -1, 0)  # (NLIMBS, ...batch)
+
+    def body(u, a_i):
+        u = u + a_i[..., None] * b
+        m = (u[..., 0] * _u32(N0INV)) & MASK
+        u = u + m[..., None] * _P
+        carry = u[..., 0] >> LIMB_BITS
+        head = (u[..., 1] + carry)[..., None]
+        u = jnp.concatenate([head, u[..., 2:], jnp.zeros_like(u[..., :1])], axis=-1)
+        return u, None
+
+    u, _ = jax.lax.scan(body, jnp.zeros_like(b), a_s)
+    return _cond_sub_p(_carry_scan(u))
+
+
+def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mont_mul(a, a)
+
+
+def to_mont(a: jnp.ndarray) -> jnp.ndarray:
+    """Plain limbs (value < p) -> Montgomery form."""
+    return mont_mul(a, _R2)
+
+
+def from_mont(a: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery form -> plain canonical limbs."""
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return mont_mul(a, one)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical limbs -> bool (...,)."""
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """where(cond, a, b) with cond shaped (...,) against (..., NLIMBS)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# fixed-exponent powers (exponent is a compile-time python int)
+# ---------------------------------------------------------------------------
+
+
+def _exp_bits(e: int) -> np.ndarray:
+    """MSB-first bit array of a positive python int."""
+    bits = bin(e)[2:]
+    return np.frombuffer(bits.encode(), dtype=np.uint8).astype(np.uint32) - ord("0")
+
+
+def mont_pow_fixed(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e in Montgomery form (a Montgomery in, result Montgomery out)."""
+    if e == 0:
+        return jnp.broadcast_to(_ONE_M, a.shape)
+    bits = jnp.asarray(_exp_bits(e))
+
+    def body(acc, bit):
+        acc = mont_mul(acc, acc)
+        acc = select(bit != 0, mont_mul(acc, a), acc)
+        return acc, None
+
+    acc = jnp.broadcast_to(_ONE_M, a.shape)
+    acc, _ = jax.lax.scan(body, acc, bits)
+    return acc
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Multiplicative inverse via Fermat (a^(p-2)); a in Montgomery form.
+
+    inv(0) returns 0 (callers guard; matches constant-shape control flow).
+    """
+    from lodestar_tpu.crypto.bls.fields import P
+
+    return mont_pow_fixed(a, P - 2)
+
+
+# host<->device element helpers -------------------------------------------------
+
+
+def encode_int(x: int) -> np.ndarray:
+    """Host: python int mod p -> canonical Montgomery limbs (numpy)."""
+    from lodestar_tpu.crypto.bls.fields import P
+    from .limbs import int_to_limbs, to_mont_int
+
+    return int_to_limbs(to_mont_int(x % P))
+
+
+def decode(limbs) -> int:
+    """Host: Montgomery limb array -> python int in [0, p)."""
+    from .limbs import from_mont_int, limbs_to_int
+
+    return from_mont_int(limbs_to_int(np.asarray(limbs)))

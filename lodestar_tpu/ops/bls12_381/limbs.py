@@ -1,0 +1,66 @@
+"""Limb representation constants and host-side conversions.
+
+Why radix 2**13 with uint32 limbs: the TPU VPU has no 64-bit integer
+multiplier, so the classic 64/32-bit bignum radices are out.  With 13-bit
+limbs, a full CIOS Montgomery-multiplication column never exceeds
+``2*NLIMBS*(2^13-1)^2 + carry < 2^32`` (see fp.py for the exact bound), so the
+whole multiplier runs in native uint32 ops with carries materialised only once
+per scan step.  381-bit Fp needs ceil(381/13) = 30 limbs; R = 2^390.
+
+The reference client gets this math from the C ``blst`` library
+(packages/beacon-node/src/chain/bls/maybeBatch.ts:17); here it is a JAX
+program so it can be vmapped/sharded across a TPU mesh.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from lodestar_tpu.crypto.bls.fields import P
+
+LIMB_BITS = 13
+NLIMBS = 30
+MASK = (1 << LIMB_BITS) - 1
+R_EXP = LIMB_BITS * NLIMBS  # 390
+R = 1 << R_EXP
+assert R > P * 2, "R must exceed 2p for Montgomery bounds"
+
+# -p^{-1} mod 2^LIMB_BITS — the Montgomery n' constant.
+N0INV = (-pow(P, -1, 1 << LIMB_BITS)) & MASK
+# R^2 mod p — multiply by this (Montgomery) to convert into Montgomery form.
+R2 = (R * R) % P
+# R mod p — the Montgomery representation of 1.
+R1 = R % P
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host: python int in [0, 2^390) -> uint32[NLIMBS] little-endian limbs."""
+    if not 0 <= x < R:
+        raise ValueError("value out of limb range")
+    out = np.empty(NLIMBS, dtype=np.uint32)
+    for i in range(NLIMBS):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Host: limb array (any int dtype, canonical or not) -> python int."""
+    arr = np.asarray(limbs, dtype=np.uint64)
+    x = 0
+    for i in range(NLIMBS - 1, -1, -1):
+        x = (x << LIMB_BITS) + int(arr[i])
+    return x
+
+
+P_LIMBS = int_to_limbs(P)
+R2_LIMBS = int_to_limbs(R2)
+ONE_MONT = int_to_limbs(R1)  # 1 in Montgomery form
+ZERO = np.zeros(NLIMBS, dtype=np.uint32)
+
+
+def to_mont_int(x: int) -> int:
+    return (x * R) % P
+
+
+def from_mont_int(x: int) -> int:
+    return (x * pow(R, -1, P)) % P

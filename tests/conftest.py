@@ -1,0 +1,14 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware isn't available in CI; sharding correctness is
+validated on a host-platform device mesh exactly as the driver's
+``dryrun_multichip`` does.  Must run before any ``import jax``.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -265,3 +265,29 @@ def test_keygen_and_infinity_rejection():
     inf_pk = curve.g1_to_bytes(None)
     with pytest.raises(api.BlsError):
         api.PublicKey.from_bytes(inf_pk)
+
+
+def test_final_exp_hard_part_chain_matches_integer_exponent():
+    """The x-adic chain must equal the direct integer exponent (cubed)."""
+    from lodestar_tpu.crypto.bls import fields
+    from lodestar_tpu.crypto.bls.pairing import _HARD_EXP, hard_part_x_chain
+
+    f = pairing.miller_loop(G2_GEN, G1_GEN)
+    # easy part puts f into the cyclotomic subgroup (chain precondition)
+    f1 = fields.f12_mul(fields.f12_conj(f), fields.f12_inv(f))
+    m = fields.f12_mul(fields.f12_frobenius(f1, 2), f1)
+    assert hard_part_x_chain(m) == fields.f12_pow(m, 3 * _HARD_EXP)
+
+
+def test_eth_fast_aggregate_verify_empty_case():
+    """Consensus-spec divergence: no pubkeys + infinity signature is valid."""
+    inf_sig = api.Signature.from_bytes(b"\xc0" + bytes(95))
+    assert api.eth_fast_aggregate_verify([], b"msg", inf_sig) is True
+    assert api.fast_aggregate_verify([], b"msg", inf_sig) is False
+    # non-empty falls through to the normal path
+    sk = api.SecretKey.from_bytes((7).to_bytes(32, "big"))
+    pk = sk.to_public_key()
+    msg = b"sync committee msg"
+    sig = sk.sign(msg)
+    assert api.eth_fast_aggregate_verify([pk], msg, sig) is True
+    assert api.eth_fast_aggregate_verify([pk], b"other", sig) is False

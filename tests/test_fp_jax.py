@@ -1,0 +1,109 @@
+"""Differential tests: JAX limb Fp engine vs the pure-Python oracle."""
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto.bls import fields as oracle
+from lodestar_tpu.ops.bls12_381 import fp
+from lodestar_tpu.ops.bls12_381.limbs import (
+    MASK,
+    NLIMBS,
+    P_LIMBS,
+    int_to_limbs,
+    limbs_to_int,
+    to_mont_int,
+)
+
+P = oracle.P
+rng = random.Random(0xB15)
+
+
+def rand_fp(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def enc(xs):
+    """list[int] -> (n, NLIMBS) Montgomery limb batch."""
+    return jnp.asarray(np.stack([int_to_limbs(to_mont_int(x)) for x in xs]))
+
+
+def dec(arr):
+    """Montgomery limb batch -> list[int]."""
+    out = np.asarray(fp.from_mont(arr))
+    return [limbs_to_int(row) for row in out]
+
+
+def test_limb_roundtrip():
+    for x in rand_fp(20) + [0, 1, P - 1]:
+        assert limbs_to_int(int_to_limbs(x)) == x
+
+
+def test_mont_roundtrip():
+    xs = rand_fp(33) + [0, 1, P - 1]
+    assert dec(enc(xs)) == xs
+
+
+@pytest.mark.parametrize(
+    "name,jax_op,py_op",
+    [
+        ("add", fp.add, oracle.fp_add),
+        ("sub", fp.sub, oracle.fp_sub),
+        ("mul", fp.mont_mul, oracle.fp_mul),
+    ],
+)
+def test_binary_ops(name, jax_op, py_op):
+    n = 64
+    xs, ys = rand_fp(n), rand_fp(n)
+    # include tricky pairs
+    xs += [0, 0, P - 1, P - 1, 1]
+    ys += [0, P - 1, P - 1, 1, P - 1]
+    got = dec(jax_op(enc(xs), enc(ys)))
+    want = [py_op(a, b) for a, b in zip(xs, ys)]
+    assert got == want
+
+
+def test_neg_sqr():
+    xs = rand_fp(32) + [0, 1, P - 1]
+    e = enc(xs)
+    assert dec(fp.neg(e)) == [oracle.fp_neg(x) for x in xs]
+    assert dec(fp.mont_sqr(e)) == [x * x % P for x in xs]
+
+
+def test_inv():
+    xs = rand_fp(8) + [1, P - 1]
+    got = dec(fp.inv(enc(xs)))
+    assert got == [oracle.fp_inv(x) for x in xs]
+
+
+def test_pow_fixed():
+    xs = rand_fp(4)
+    e = 0xD201000000010000
+    got = dec(fp.mont_pow_fixed(enc(xs), e))
+    assert got == [pow(x, e, P) for x in xs]
+
+
+def test_canonical_limbs():
+    """All ops must emit canonical limbs (< 2^13)."""
+    xs, ys = rand_fp(16), rand_fp(16)
+    a, b = enc(xs), enc(ys)
+    for out in (fp.add(a, b), fp.sub(a, b), fp.mont_mul(a, b), fp.neg(a)):
+        arr = np.asarray(out)
+        assert arr.max() <= MASK
+        for row in arr:
+            assert limbs_to_int(row) < P
+
+
+def test_jit_and_grad_free_shapes():
+    """mont_mul under jit with different batch shapes (no recompile errors)."""
+    f = jax.jit(fp.mont_mul)
+    xs, ys = rand_fp(5), rand_fp(5)
+    got = dec(f(enc(xs), enc(ys)))
+    assert got == [a * b % P for a, b in zip(xs, ys)]
+    # scalar (no batch) shape
+    one = enc([xs[0]])[0]
+    two = enc([ys[0]])[0]
+    assert fp.decode(np.asarray(fp.mont_mul(one, two))) == xs[0] * ys[0] % P
