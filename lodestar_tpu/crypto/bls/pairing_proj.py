@@ -1,0 +1,145 @@
+"""Projective (inversion-free) Miller loop — CPU prototype of the TPU kernel.
+
+This module is the validated formula template for the JAX engine
+(lodestar_tpu/ops/bls12_381/pairing.py): homogeneous-projective point updates
+on the twist E'(Fp2) with sparse line evaluation, no field inversions inside
+the loop (the oracle's pairing.py uses affine lines + Fp12 inversions, which
+would be prohibitive as a per-step device op).
+
+Derivation (matches the oracle's untwist (x', y') -> (x' w^-2, y' w^-3) for
+the M-twist E': y^2 = x^3 + 4(1+u), w^6 = xi = 1+u):
+
+  Tangent at T=(X,Y,Z):   theta = 3X^2, lam = 2YZ
+  Chord T,Q2=(x2,y2):     theta = y2 Z - Y, lam = x2 Z - X
+
+  Scaled line value at P=(xP, yP) (scale factors lie in Fp2 and cancel under
+  the final exponentiation):
+      L = theta*xP * w^5  +  d1 * w^3  -  xi*lam_z*yP
+  with (doubling)  d1 = 2Y^2 Z - 3X^3,          lam_z = 2YZ^2
+       (addition)  d1 = lam*y2 - theta*x2,      lam_z = lam
+
+  i.e. in the tower layout Fp12 = ((c0,c1,c2),(d0,d1,d2)) the line is the
+  sparse element ((c0,0,0),(0,d1,d2)) — "slots 0,3,5" of the w-basis.
+
+Point updates (generic Weierstrass, homogeneous):
+  double:  X3 = 2XYZ(9X^3 - 8Y^2 Z)
+           Y3 = 9X^3(4Y^2 Z - 3X^3) - 8Y^4 Z^2
+           Z3 = 8 Y^3 Z^3
+  mixed add (Z2=1):  N  = theta^2 Z - 2 lam^2 X - lam^3
+           X3 = lam * N
+           Y3 = theta*(lam^2 X - N) - lam^3 Y
+           Z3 = lam^3 Z
+
+Validated against the oracle pairing in tests/test_pairing_proj.py; the JAX
+engine then ports these formulas verbatim onto limb tensors.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from .curve import AffineG1, AffineG2
+from .fields import (
+    ABS_X,
+    F12_ONE,
+    Fp2T,
+    Fp12T,
+    F2_ZERO,
+    f2_add,
+    f2_mul,
+    f2_mul_by_xi,
+    f2_mul_scalar,
+    f2_neg,
+    f2_sqr,
+    f2_sub,
+    f12_conj,
+    f12_mul,
+    f12_sqr,
+)
+from .pairing import final_exponentiation
+
+ProjG2 = Tuple[Fp2T, Fp2T, Fp2T]  # homogeneous (X, Y, Z), never infinity here
+
+
+def _line_sparse(c0: Fp2T, d1: Fp2T, d2: Fp2T) -> Fp12T:
+    return ((c0, F2_ZERO, F2_ZERO), (F2_ZERO, d1, d2))
+
+
+def _dbl_step(t: ProjG2, xp: int, yp: int):
+    """Double T and return (line(P), 2T)."""
+    X, Y, Z = t
+    xx = f2_sqr(X)            # X^2
+    yy = f2_sqr(Y)            # Y^2
+    x3 = f2_mul(xx, X)        # X^3
+    yyz = f2_mul(yy, Z)       # Y^2 Z
+    # line
+    c0 = f2_mul_by_xi(f2_mul_scalar(f2_mul(f2_mul(Y, Z), Z), 2 * yp))  # 2 xi Y Z^2 yP
+    c0 = f2_neg(c0)
+    d1 = f2_sub(f2_mul_scalar(yyz, 2), f2_mul_scalar(x3, 3))           # 2Y^2Z - 3X^3
+    d2 = f2_mul_scalar(f2_mul(xx, Z), 3 * xp)                          # 3 X^2 Z xP
+    # update
+    x3_9 = f2_mul_scalar(x3, 9)
+    yyz_8 = f2_mul_scalar(yyz, 8)
+    Xn = f2_mul(f2_mul_scalar(f2_mul(f2_mul(X, Y), Z), 2), f2_sub(x3_9, yyz_8))
+    Yn = f2_sub(
+        f2_mul(x3_9, f2_sub(f2_mul_scalar(yyz, 4), f2_mul_scalar(x3, 3))),
+        f2_mul_scalar(f2_sqr(yyz), 8),
+    )
+    Zn = f2_mul_scalar(f2_mul(f2_mul(yy, Y), f2_mul(f2_sqr(Z), Z)), 8)
+    return _line_sparse(c0, d1, d2), (Xn, Yn, Zn)
+
+
+def _add_step(t: ProjG2, q: Tuple[Fp2T, Fp2T], xp: int, yp: int):
+    """Mixed-add affine q into T and return (line(P), T+Q)."""
+    X, Y, Z = t
+    x2, y2 = q
+    theta = f2_sub(f2_mul(y2, Z), Y)
+    lam = f2_sub(f2_mul(x2, Z), X)
+    # line
+    c0 = f2_neg(f2_mul_by_xi(f2_mul_scalar(lam, yp)))
+    d1 = f2_sub(f2_mul(lam, y2), f2_mul(theta, x2))
+    d2 = f2_mul_scalar(theta, xp)
+    # update
+    ll = f2_sqr(lam)          # lam^2
+    lll = f2_mul(ll, lam)     # lam^3
+    llx = f2_mul(ll, X)
+    n = f2_sub(f2_sub(f2_mul(f2_sqr(theta), Z), f2_mul_scalar(llx, 2)), lll)
+    Xn = f2_mul(lam, n)
+    Yn = f2_sub(f2_mul(theta, f2_sub(llx, n)), f2_mul(lll, Y))
+    Zn = f2_mul(lll, Z)
+    return _line_sparse(c0, d1, d2), (Xn, Yn, Zn)
+
+
+def miller_loop_proj(q: AffineG2, p: AffineG1) -> Fp12T:
+    """f_{|x|,Q}(P) (conjugated for x < 0) with projective steps.
+
+    Agrees with the oracle miller_loop up to subfield factors — i.e. exactly
+    after final exponentiation.
+    """
+    if q is None or p is None:
+        return F12_ONE
+    xp, yp = p
+    t: ProjG2 = (q[0], q[1], (1, 0))
+    f = F12_ONE
+    for bit in bin(ABS_X)[3:]:
+        line, t = _dbl_step(t, xp, yp)
+        f = f12_mul(f12_sqr(f), line)
+        if bit == "1":
+            line, t = _add_step(t, q, xp, yp)
+            f = f12_mul(f, line)
+    return f12_conj(f)
+
+
+def pairing_proj(p: AffineG1, q: AffineG2) -> Fp12T:
+    return final_exponentiation(miller_loop_proj(q, p))
+
+
+def multi_pairing_is_one_proj(pairs: Sequence[Tuple[AffineG1, AffineG2]]) -> bool:
+    acc = F12_ONE
+    for p, q in pairs:
+        if p is None or q is None:
+            continue
+        acc = f12_mul(acc, miller_loop_proj(q, p))
+    fe = final_exponentiation(acc)
+    from .fields import f12_is_one
+
+    return f12_is_one(fe)

@@ -6,8 +6,13 @@ leading axes, so a batch of field elements is just a leading dimension — the
 TPU-native analogue of the reference's per-core BLS worker data parallelism
 (packages/beacon-node/src/chain/bls/multithread/index.ts:98).
 
-Sequential structure (carry chains, CIOS) is expressed as ``lax.scan`` over
-the limb axis so XLA traces a single step regardless of batch size.
+Design note on carry handling: carry/borrow propagation is NOT a sequential
+scan here.  A pairing is ~10^5 field ops; giving each one a ``lax.scan``
+produces thousands of XLA while-subcomputations and intractable compile
+times.  Instead, carries resolve in log2(NLIMBS) Hillis-Steele steps of the
+classic (generate, propagate) carry-lookahead monoid — straight-line
+elementwise HLO that XLA fuses.  The only remaining loop is the CIOS
+Montgomery multiplier itself (unrolled by default: 30 static steps).
 
 Overflow audit for mont_mul (uint32, b = 2^13-1 = 8191):
   * product a_i*b_j <= 8191^2 = 67,092,481 < 2^27
@@ -25,6 +30,9 @@ from .limbs import LIMB_BITS, MASK, NLIMBS, N0INV, ONE_MONT, P_LIMBS, R2_LIMBS
 
 _u32 = jnp.uint32
 
+# Unroll the 30-step CIOS loop into straight-line code (no while loop).
+CIOS_UNROLL = True
+
 # Device-constant views of host numpy constants (closed over inside jit).
 _P = jnp.asarray(P_LIMBS, dtype=_u32)
 _R2 = jnp.asarray(R2_LIMBS, dtype=_u32)
@@ -40,59 +48,76 @@ def one_mont(shape=()) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# carry / borrow primitives
+# carry / borrow primitives (log-depth, no scans)
 # ---------------------------------------------------------------------------
 
 
-def _carry_once(x: jnp.ndarray) -> jnp.ndarray:
-    """One parallel carry pass; exact iff each limb < 2^14 and value < 2^390.
+def _shift_up(x):
+    """Limb k of result = limb k-1 of x (i.e. multiply by 2^13), zero-fill."""
+    return jnp.concatenate([jnp.zeros_like(x[..., :1]), x[..., :-1]], axis=-1)
 
-    For limbs <= 2*MASK (a single addition of canonical values) the result is
-    fully canonical: (2*MASK & MASK) = MASK-1, +carry(<=1) <= MASK.
+
+def _carry_pass(x):
+    """One parallel carry pass: limbs shrink toward canonical."""
+    return (x & MASK) + _shift_up(x >> LIMB_BITS)
+
+
+def _lookahead(g, pr):
+    """Inclusive prefix of the carry monoid along the limb axis.
+
+    g[i]: limb i generates a carry regardless of carry-in.
+    pr[i]: limb i propagates an incoming carry.
+    Returns carry-out flags per limb (uint32 0/1).
     """
-    low = x & MASK
-    carry = x >> LIMB_BITS
-    shifted = jnp.concatenate(
-        [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1
-    )
-    return low + shifted
+    d = 1
+    while d < NLIMBS:
+        g_lo = _shift_up_by(g, d)
+        p_lo = _shift_up_by(pr, d)
+        g = g | (pr & g_lo)
+        pr = pr & p_lo
+        d *= 2
+    return g
 
 
-def _carry_scan(x: jnp.ndarray) -> jnp.ndarray:
-    """Full normalization for limbs up to 2^32: sequential carry scan.
+def _shift_up_by(x, d):
+    return jnp.concatenate([jnp.zeros_like(x[..., :d]), x[..., :-d]], axis=-1)
 
-    Drops the final carry (caller guarantees value < 2^390).
+
+def _resolve_single_carries(t):
+    """Exact canonicalization for limbs < 2^14 with single-bit carries.
+
+    Valid when every limb of t is <= 2^14 - 1 (so carry-out is 0 or 1).
     """
-    xs = jnp.moveaxis(x, -1, 0)
-
-    def body(carry, xi):
-        cur = xi + carry
-        return cur >> LIMB_BITS, cur & MASK
-
-    _, ys = jax.lax.scan(body, jnp.zeros_like(xs[0]), xs)
-    return jnp.moveaxis(ys, 0, -1)
+    g = (t >> LIMB_BITS).astype(_u32)          # t >= 2^13 -> generates
+    pr = (t == MASK).astype(_u32)              # t == mask -> propagates
+    carry_out = _lookahead(g, pr)
+    carry_in = _shift_up(carry_out)
+    return (t + carry_in) & MASK
 
 
-def _borrow_sub(a: jnp.ndarray, b: jnp.ndarray):
-    """(a - b) mod 2^390 with canonical inputs; returns (limbs, borrow_flag).
+def _norm_wide(u):
+    """Canonicalize limbs up to 2^32 (mont_mul output): 2 passes + lookahead."""
+    u = _carry_pass(u)   # limbs <= mask + 2^19
+    u = _carry_pass(u)   # limbs <= mask + 61 < 2^14
+    return _resolve_single_carries(u)
 
-    borrow_flag (uint32 0/1) is 1 iff a < b.
+
+def _borrow_sub(a, b):
+    """(a - b) mod 2^390 on canonical limbs; returns (limbs, borrow_flag).
+
+    borrow_flag (uint32 0/1 shaped (...,)) is 1 iff a < b.
     """
-    a_s = jnp.moveaxis(a, -1, 0)
-    b_s = jnp.moveaxis(jnp.broadcast_to(b, a.shape), -1, 0)
-
-    def body(borrow, ab):
-        ai, bi = ab
-        t = ai + _u32(1 << LIMB_BITS) - bi - borrow
-        return _u32(1) - (t >> LIMB_BITS), t & MASK
-
-    borrow, ys = jax.lax.scan(body, jnp.zeros_like(a_s[0]), (a_s, b_s))
-    return jnp.moveaxis(ys, 0, -1), borrow
+    g = (a < b).astype(_u32)
+    pr = (a == b).astype(_u32)
+    borrow_out = _lookahead(g, pr)
+    borrow_in = _shift_up(borrow_out)
+    limbs = (a + _u32(1 << LIMB_BITS) - b - borrow_in) & MASK
+    return limbs, borrow_out[..., -1]
 
 
-def _cond_sub_p(t: jnp.ndarray) -> jnp.ndarray:
-    """Canonicalize t in [0, 2p) -> [0, p)."""
-    d, borrow = _borrow_sub(t, _P)
+def _cond_sub_p(t):
+    """Canonicalize t in [0, 2p) -> [0, p) (canonical limbs in)."""
+    d, borrow = _borrow_sub(t, jnp.broadcast_to(_P, t.shape))
     return jnp.where((borrow != 0)[..., None], t, d)
 
 
@@ -102,15 +127,15 @@ def _cond_sub_p(t: jnp.ndarray) -> jnp.ndarray:
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return _cond_sub_p(_carry_once(a + b))
+    return _cond_sub_p(_resolve_single_carries(a + b))
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     a, b = jnp.broadcast_arrays(a, b)
     d, borrow = _borrow_sub(a, b)
-    # If a < b the limbs represent a-b+2^390; adding p and dropping the top
-    # carry (which is exactly 2^390 here) yields a-b+p in [0, p).
-    dp = _carry_once(d + _P)
+    # Where a < b the limbs represent a-b+2^390; adding p and dropping the
+    # top carry (exactly 2^390) yields a-b+p in [0, p).
+    dp = _resolve_single_carries(d + _P)
     return jnp.where((borrow != 0)[..., None], dp, d)
 
 
@@ -122,25 +147,27 @@ def dbl(a: jnp.ndarray) -> jnp.ndarray:
     return add(a, a)
 
 
+def _cios_step(u, a_i, b):
+    u = u + a_i[..., None] * b
+    m = (u[..., 0] * _u32(N0INV)) & MASK
+    u = u + m[..., None] * _P
+    carry = u[..., 0] >> LIMB_BITS
+    head = (u[..., 1] + carry)[..., None]
+    return jnp.concatenate([head, u[..., 2:], jnp.zeros_like(u[..., :1])], axis=-1)
+
+
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Montgomery product a*b*R^{-1} mod p, canonical output.
-
-    CIOS over a's limbs as a lax.scan: one traced step regardless of batch.
-    """
+    """Montgomery product a*b*R^{-1} mod p, canonical output (CIOS)."""
     a, b = jnp.broadcast_arrays(a, b)
-    a_s = jnp.moveaxis(a, -1, 0)  # (NLIMBS, ...batch)
-
-    def body(u, a_i):
-        u = u + a_i[..., None] * b
-        m = (u[..., 0] * _u32(N0INV)) & MASK
-        u = u + m[..., None] * _P
-        carry = u[..., 0] >> LIMB_BITS
-        head = (u[..., 1] + carry)[..., None]
-        u = jnp.concatenate([head, u[..., 2:], jnp.zeros_like(u[..., :1])], axis=-1)
-        return u, None
-
-    u, _ = jax.lax.scan(body, jnp.zeros_like(b), a_s)
-    return _cond_sub_p(_carry_scan(u))
+    if CIOS_UNROLL:
+        u = jnp.zeros_like(b)
+        for i in range(NLIMBS):
+            u = _cios_step(u, a[..., i], b)
+    else:
+        a_s = jnp.moveaxis(a, -1, 0)
+        u, _ = jax.lax.scan(lambda u, ai: (_cios_step(u, ai, b), None),
+                            jnp.zeros_like(b), a_s)
+    return _cond_sub_p(_norm_wide(u))
 
 
 def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -149,7 +176,7 @@ def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
 
 def to_mont(a: jnp.ndarray) -> jnp.ndarray:
     """Plain limbs (value < p) -> Montgomery form."""
-    return mont_mul(a, _R2)
+    return mont_mul(a, jnp.broadcast_to(_R2, a.shape))
 
 
 def from_mont(a: jnp.ndarray) -> jnp.ndarray:
@@ -179,12 +206,15 @@ def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def _exp_bits(e: int) -> np.ndarray:
     """MSB-first bit array of a positive python int."""
-    bits = bin(e)[2:]
-    return np.frombuffer(bits.encode(), dtype=np.uint8).astype(np.uint32) - ord("0")
+    return np.array([int(c) for c in bin(e)[2:]], dtype=np.uint32)
 
 
 def mont_pow_fixed(a: jnp.ndarray, e: int) -> jnp.ndarray:
-    """a^e in Montgomery form (a Montgomery in, result Montgomery out)."""
+    """a^e in Montgomery form (a Montgomery in, result Montgomery out).
+
+    One lax.scan over the exponent bits; always-multiply-then-select keeps
+    the body branch-free.
+    """
     if e == 0:
         return jnp.broadcast_to(_ONE_M, a.shape)
     bits = jnp.asarray(_exp_bits(e))

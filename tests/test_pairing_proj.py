@@ -1,0 +1,35 @@
+"""Projective Miller loop prototype vs the affine oracle pairing."""
+from lodestar_tpu.crypto.bls import pairing as orc
+from lodestar_tpu.crypto.bls.curve import G1_GEN, G1_GEN_JAC, G2_GEN, g1, g2
+from lodestar_tpu.crypto.bls.fields import f12_mul
+from lodestar_tpu.crypto.bls.pairing_proj import (
+    multi_pairing_is_one_proj,
+    pairing_proj,
+)
+
+
+def test_generator_pairing_matches_oracle():
+    assert pairing_proj(G1_GEN, G2_GEN) == orc.pairing(G1_GEN, G2_GEN)
+
+
+def test_bilinearity():
+    e = pairing_proj(G1_GEN, G2_GEN)
+    p2 = g1.to_affine(g1.double(G1_GEN_JAC))
+    assert pairing_proj(p2, G2_GEN) == f12_mul(e, e)
+    q2 = g2.to_affine(g2.double(g2.from_affine(G2_GEN)))
+    assert pairing_proj(G1_GEN, q2) == f12_mul(e, e)
+
+
+def test_random_point_matches_oracle():
+    k = 0xDEADBEEFCAFE
+    pa = g1.to_affine(g1.mul_scalar(G1_GEN_JAC, k))
+    qa = g2.to_affine(g2.mul_scalar(g2.from_affine(G2_GEN), 98765))
+    assert pairing_proj(pa, qa) == orc.pairing(pa, qa)
+
+
+def test_multi_pairing_is_one():
+    neg_g1 = g1.to_affine(g1.neg_pt(G1_GEN_JAC))
+    qa = g2.to_affine(g2.mul_scalar(g2.from_affine(G2_GEN), 12345))
+    pa = g1.to_affine(g1.mul_scalar(G1_GEN_JAC, 12345))
+    assert multi_pairing_is_one_proj([(pa, G2_GEN), (neg_g1, qa)])
+    assert not multi_pairing_is_one_proj([(pa, G2_GEN), (neg_g1, G2_GEN)])

@@ -1,0 +1,141 @@
+"""Differential tests: JAX tower fields vs the pure-Python oracle.
+
+Ops are jitted and applied to small batches — mirrors real usage (the tower
+only ever runs inside one compiled pairing program) and avoids the cost of
+eagerly dispatching thousands of scan primitives.
+"""
+import random
+
+import jax
+import numpy as np
+
+from lodestar_tpu.crypto.bls import fields as orc
+from lodestar_tpu.ops.bls12_381 import tower as tw
+
+P = orc.P
+rng = random.Random(0x70E3)
+N = 4  # batch size per op
+
+
+def rf2():
+    return (rng.randrange(P), rng.randrange(P))
+
+
+def rf6():
+    return (rf2(), rf2(), rf2())
+
+
+def rf12():
+    return (rf6(), rf6())
+
+
+def _stack(pytrees):
+    return jax.tree.map(lambda *xs: np.stack(xs), *pytrees)
+
+
+def _unstack_fp2(batch, i):
+    return (np.asarray(batch[0])[i], np.asarray(batch[1])[i])
+
+
+def enc2(vals):
+    return _stack([tw.encode_fp2(v) for v in vals])
+
+
+def enc6(vals):
+    return _stack([tw.encode_fp6(v) for v in vals])
+
+
+def enc12(vals):
+    return _stack([tw.encode_fp12(v) for v in vals])
+
+
+def dec2(batch):
+    return [tw.decode_fp2(jax.tree.map(lambda x: np.asarray(x)[i], batch)) for i in range(N)]
+
+
+def dec6(batch):
+    return [tw.decode_fp6(jax.tree.map(lambda x: np.asarray(x)[i], batch)) for i in range(N)]
+
+
+def dec12(batch):
+    return [tw.decode_fp12(jax.tree.map(lambda x: np.asarray(x)[i], batch)) for i in range(N)]
+
+
+def test_fp2_ops():
+    a, b = [rf2() for _ in range(N)], [rf2() for _ in range(N)]
+    ea, eb = enc2(a), enc2(b)
+
+    @jax.jit
+    def all_ops(x, y):
+        return (
+            tw.f2_mul(x, y),
+            tw.f2_sqr(x),
+            tw.f2_add(x, y),
+            tw.f2_sub(x, y),
+            tw.f2_mul_by_xi(x),
+            tw.f2_inv(x),
+        )
+
+    mul, sqr, add, sub, xi, inv = all_ops(ea, eb)
+    assert dec2(mul) == [orc.f2_mul(x, y) for x, y in zip(a, b)]
+    assert dec2(sqr) == [orc.f2_sqr(x) for x in a]
+    assert dec2(add) == [orc.f2_add(x, y) for x, y in zip(a, b)]
+    assert dec2(sub) == [orc.f2_sub(x, y) for x, y in zip(a, b)]
+    assert dec2(xi) == [orc.f2_mul_by_xi(x) for x in a]
+    assert dec2(inv) == [orc.f2_inv(x) for x in a]
+
+
+def test_fp6_ops():
+    a, b = [rf6() for _ in range(N)], [rf6() for _ in range(N)]
+    ea, eb = enc6(a), enc6(b)
+
+    @jax.jit
+    def ops(x, y):
+        return tw.f6_mul(x, y), tw.f6_mul_by_v(x)
+
+    mul, mv = ops(ea, eb)
+    assert dec6(mul) == [orc.f6_mul(x, y) for x, y in zip(a, b)]
+    assert dec6(mv) == [orc.f6_mul_by_v(x) for x in a]
+
+
+def test_fp12_ops():
+    a, b = [rf12() for _ in range(N)], [rf12() for _ in range(N)]
+    ea, eb = enc12(a), enc12(b)
+
+    @jax.jit
+    def ops(x, y):
+        return tw.f12_mul(x, y), tw.f12_sqr(x), tw.f12_conj(x)
+
+    mul, sqr, conj = ops(ea, eb)
+    assert dec12(mul) == [orc.f12_mul(x, y) for x, y in zip(a, b)]
+    assert dec12(sqr) == [orc.f12_sqr(x) for x in a]
+    assert dec12(conj) == [orc.f12_conj(x) for x in a]
+
+
+def test_fp12_inv():
+    a = [rf12() for _ in range(N)]
+    ea = enc12(a)
+    inv = jax.jit(tw.f12_inv)(ea)
+    assert dec12(inv) == [orc.f12_inv(x) for x in a]
+
+
+def test_frobenius():
+    a = [rf12() for _ in range(N)]
+    ea = enc12(a)
+
+    @jax.jit
+    def frob(x):
+        return tw.f12_frobenius(x, 1), tw.f12_frobenius(x, 2), tw.f12_frobenius(x, 6)
+
+    f1, f2, f6 = frob(ea)
+    assert dec12(f1) == [orc.f12_frobenius(x, 1) for x in a]
+    assert dec12(f2) == [orc.f12_frobenius(x, 2) for x in a]
+    assert dec12(f6) == [orc.f12_frobenius(x, 6) for x in a]
+
+
+def test_is_one():
+    ones = enc12([orc.F12_ONE] * N)
+    rand = enc12([rf12() for _ in range(N)])
+    f = jax.jit(tw.f12_is_one)
+    assert np.asarray(f(ones)).all()
+    assert not np.asarray(f(rand)).any()
