@@ -1,0 +1,236 @@
+"""Runtime chain configuration — the rebuild's `@lodestar/config`.
+
+Mirrors packages/config/src: IChainConfig runtime variables
+(chainConfig/types.ts), the mainnet/minimal defaults
+(chainConfig/presets/{mainnet,minimal}.ts), the fork schedule helpers
+(forkConfig/), and the genesis-anchored BeaconConfig with cached fork
+digests (beaconConfig.ts).  YAML config loading follows the
+consensus-specs config file format (chainConfig/json.ts role).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+from lodestar_tpu.params import (
+    ACTIVE_PRESET_NAME,
+    FORK_ORDER,
+    FORK_SEQ,
+    ForkName,
+    SLOTS_PER_EPOCH,
+)
+
+FAR_FUTURE_EPOCH = 2**64 - 1
+
+
+@dataclass(frozen=True)
+class ChainConfig:
+    PRESET_BASE: str = "mainnet"
+    CONFIG_NAME: str = "mainnet"
+    # Transition
+    TERMINAL_TOTAL_DIFFICULTY: int = 58750000000000000000000
+    TERMINAL_BLOCK_HASH: bytes = b"\x00" * 32
+    TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH: int = FAR_FUTURE_EPOCH
+    # Genesis
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT: int = 16384
+    MIN_GENESIS_TIME: int = 1606824000
+    GENESIS_FORK_VERSION: bytes = bytes.fromhex("00000000")
+    GENESIS_DELAY: int = 604800
+    # Forking
+    ALTAIR_FORK_VERSION: bytes = bytes.fromhex("01000000")
+    ALTAIR_FORK_EPOCH: int = 74240
+    BELLATRIX_FORK_VERSION: bytes = bytes.fromhex("02000000")
+    BELLATRIX_FORK_EPOCH: int = 144896
+    CAPELLA_FORK_VERSION: bytes = bytes.fromhex("03000000")
+    CAPELLA_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    EIP4844_FORK_VERSION: bytes = bytes.fromhex("04000000")
+    EIP4844_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    # Time
+    SECONDS_PER_SLOT: int = 12
+    SECONDS_PER_ETH1_BLOCK: int = 14
+    MIN_VALIDATOR_WITHDRAWABILITY_DELAY: int = 256
+    SHARD_COMMITTEE_PERIOD: int = 256
+    ETH1_FOLLOW_DISTANCE: int = 2048
+    # Validator cycle
+    INACTIVITY_SCORE_BIAS: int = 4
+    INACTIVITY_SCORE_RECOVERY_RATE: int = 16
+    EJECTION_BALANCE: int = 16000000000
+    MIN_PER_EPOCH_CHURN_LIMIT: int = 4
+    CHURN_LIMIT_QUOTIENT: int = 65536
+    # Proposer boost
+    PROPOSER_SCORE_BOOST: int = 40
+    # Deposit contract
+    DEPOSIT_CHAIN_ID: int = 1
+    DEPOSIT_NETWORK_ID: int = 1
+    DEPOSIT_CONTRACT_ADDRESS: bytes = bytes.fromhex(
+        "00000000219ab540356cbb839cbe05303d7705fa"
+    )
+    # EIP-4844
+    MAX_REQUEST_BLOBS_SIDECARS: int = 128
+    MIN_EPOCHS_FOR_BLOBS_SIDECARS_REQUESTS: int = 4096
+
+
+mainnet_chain_config = ChainConfig()
+
+minimal_chain_config = ChainConfig(
+    PRESET_BASE="minimal",
+    CONFIG_NAME="minimal",
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=64,
+    MIN_GENESIS_TIME=1578009600,
+    GENESIS_FORK_VERSION=bytes.fromhex("00000001"),
+    GENESIS_DELAY=300,
+    ALTAIR_FORK_VERSION=bytes.fromhex("01000001"),
+    ALTAIR_FORK_EPOCH=FAR_FUTURE_EPOCH,
+    BELLATRIX_FORK_VERSION=bytes.fromhex("02000001"),
+    BELLATRIX_FORK_EPOCH=FAR_FUTURE_EPOCH,
+    CAPELLA_FORK_VERSION=bytes.fromhex("03000001"),
+    EIP4844_FORK_VERSION=bytes.fromhex("04000001"),
+    SECONDS_PER_SLOT=6,
+    MIN_VALIDATOR_WITHDRAWABILITY_DELAY=256,
+    SHARD_COMMITTEE_PERIOD=64,
+    ETH1_FOLLOW_DISTANCE=16,
+    EJECTION_BALANCE=16000000000,
+    MIN_PER_EPOCH_CHURN_LIMIT=4,
+    CHURN_LIMIT_QUOTIENT=32,
+    DEPOSIT_CHAIN_ID=5,
+    DEPOSIT_NETWORK_ID=5,
+    DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex("1234567890123456789012345678901234567890"),
+)
+
+# default config matches the active compile-time preset, like config/default.ts
+default_chain_config = (
+    mainnet_chain_config if ACTIVE_PRESET_NAME == "mainnet" else minimal_chain_config
+)
+
+
+@dataclass(frozen=True)
+class ForkInfo:
+    name: ForkName
+    epoch: int
+    version: bytes
+    prev_version: bytes
+    prev_fork_name: ForkName
+
+
+class ForkConfig:
+    """Fork schedule lookups (packages/config/src/forkConfig/index.ts)."""
+
+    def __init__(self, chain: ChainConfig):
+        self.chain = chain
+        epochs = {
+            ForkName.phase0: 0,
+            ForkName.altair: chain.ALTAIR_FORK_EPOCH,
+            ForkName.bellatrix: chain.BELLATRIX_FORK_EPOCH,
+            ForkName.capella: chain.CAPELLA_FORK_EPOCH,
+            ForkName.eip4844: chain.EIP4844_FORK_EPOCH,
+        }
+        versions = {
+            ForkName.phase0: chain.GENESIS_FORK_VERSION,
+            ForkName.altair: chain.ALTAIR_FORK_VERSION,
+            ForkName.bellatrix: chain.BELLATRIX_FORK_VERSION,
+            ForkName.capella: chain.CAPELLA_FORK_VERSION,
+            ForkName.eip4844: chain.EIP4844_FORK_VERSION,
+        }
+        self.forks: Dict[ForkName, ForkInfo] = {}
+        prev = ForkName.phase0
+        for f in FORK_ORDER:
+            self.forks[f] = ForkInfo(
+                name=f,
+                epoch=epochs[f],
+                version=versions[f],
+                prev_version=versions[prev],
+                prev_fork_name=prev,
+            )
+            if epochs[f] < FAR_FUTURE_EPOCH:
+                prev = f
+        # scheduled forks sorted ascending by epoch, phase0 first
+        self.forks_ascending: List[ForkInfo] = sorted(
+            self.forks.values(), key=lambda fi: (fi.epoch, FORK_SEQ[fi.name])
+        )
+
+    def fork_name_at_epoch(self, epoch: int) -> ForkName:
+        out = ForkName.phase0
+        for fi in self.forks_ascending:
+            if fi.epoch <= epoch:
+                out = fi.name
+        return out
+
+    def fork_name_at_slot(self, slot: int) -> ForkName:
+        return self.fork_name_at_epoch(slot // SLOTS_PER_EPOCH)
+
+    def fork_at_epoch(self, epoch: int) -> ForkInfo:
+        return self.forks[self.fork_name_at_epoch(epoch)]
+
+    def fork_version_at_epoch(self, epoch: int) -> bytes:
+        return self.fork_at_epoch(epoch).version
+
+
+def compute_fork_data_root(version: bytes, genesis_validators_root: bytes) -> bytes:
+    """hash_tree_root(ForkData) without importing the types package (it is
+    a 2-field fixed container: sha256(version32 || gvr))."""
+    return hashlib.sha256(version.ljust(32, b"\x00") + genesis_validators_root).digest()
+
+
+def compute_fork_digest(version: bytes, genesis_validators_root: bytes) -> bytes:
+    return compute_fork_data_root(version, genesis_validators_root)[:4]
+
+
+class BeaconConfig(ForkConfig):
+    """ForkConfig + genesis anchor: cached fork digests per fork
+    (packages/config/src/beaconConfig.ts createCachedGenesis)."""
+
+    def __init__(self, chain: ChainConfig, genesis_validators_root: bytes):
+        super().__init__(chain)
+        self.genesis_validators_root = genesis_validators_root
+        self._digest_by_fork: Dict[ForkName, bytes] = {}
+        self._fork_by_digest: Dict[bytes, ForkName] = {}
+        for f in FORK_ORDER:
+            d = compute_fork_digest(self.forks[f].version, genesis_validators_root)
+            self._digest_by_fork[f] = d
+            # first fork wins for duplicate digests (unscheduled forks share
+            # the digest of the fork whose version they inherit)
+            self._fork_by_digest.setdefault(d, f)
+
+    def fork_digest(self, fork: ForkName) -> bytes:
+        return self._digest_by_fork[fork]
+
+    def fork_digest_at_slot(self, slot: int) -> bytes:
+        return self._digest_by_fork[self.fork_name_at_slot(slot)]
+
+    def fork_from_digest(self, digest: bytes) -> ForkName:
+        if digest not in self._fork_by_digest:
+            raise ValueError(f"unknown fork digest {digest.hex()}")
+        return self._fork_by_digest[digest]
+
+
+def create_fork_config(chain: ChainConfig) -> ForkConfig:
+    return ForkConfig(chain)
+
+
+def create_beacon_config(
+    chain: ChainConfig, genesis_validators_root: bytes
+) -> BeaconConfig:
+    return BeaconConfig(chain, genesis_validators_root)
+
+
+def chain_config_from_dict(data: dict, base: Optional[ChainConfig] = None) -> ChainConfig:
+    """Build a ChainConfig from a consensus-specs YAML-style dict (string
+    values allowed, hex strings for bytes fields) layered over `base`."""
+    base = base or default_chain_config
+    kwargs = {}
+    for fname, f in ChainConfig.__dataclass_fields__.items():
+        if fname not in data:
+            continue
+        raw = data[fname]
+        cur = getattr(base, fname)
+        if isinstance(cur, bytes):
+            s = raw if isinstance(raw, str) else str(raw)
+            kwargs[fname] = bytes.fromhex(s.removeprefix("0x"))
+        elif isinstance(cur, bool):
+            kwargs[fname] = bool(raw)
+        elif isinstance(cur, int):
+            kwargs[fname] = int(raw)
+        else:
+            kwargs[fname] = raw
+    return replace(base, **kwargs)

@@ -145,19 +145,39 @@ def hard_part_x_chain(m: Fp12T) -> Fp12T:
     return f12_mul(t4, f12_mul(f12_sqr(m), m))
 
 
-def final_exponentiation(f: Fp12T) -> Fp12T:
-    # easy part: f^((p^6 - 1)(p^2 + 1))
+def _easy_part(f: Fp12T) -> Fp12T:
+    """f^((p^6 - 1)(p^2 + 1)) — shared by both hard-part variants."""
     f1 = f12_mul(f12_conj(f), f12_inv(f))          # f^(p^6 - 1)
-    f2 = f12_mul(f12_frobenius(f1, 2), f1)         # ^(p^2 + 1)
+    return f12_mul(f12_frobenius(f1, 2), f1)       # ^(p^2 + 1)
+
+
+def final_exponentiation(f: Fp12T) -> Fp12T:
     # hard part (times 3, see hard_part_x_chain)
-    return hard_part_x_chain(f2)
+    return hard_part_x_chain(_easy_part(f))
 
 
 def pairing(p: AffineG1, q: AffineG2) -> Fp12T:
-    """e(P, Q) for P in G1, Q in G2 (affine, None = infinity)."""
+    """e(P, Q)^3 for P in G1, Q in G2 (affine, None = infinity).
+
+    NOTE: this returns the standard ate pairing CUBED — final_exponentiation
+    uses the x-adic hard part 3*(p^4-p^2+1)/r (see hard_part_x_chain).  All
+    is-one / equality / bilinearity checks are unaffected (gcd(3, r) = 1 so
+    g -> g^3 is a bijection of the r-torsion GT), and the TPU engine
+    implements the identical chain, so the two engines agree bit-for-bit.
+    Only cross-implementation GT *serialization* vectors would differ; use
+    pairing_standard() for those.
+    """
     if p is None or q is None:
         return F12_ONE
     return final_exponentiation(miller_loop(q, p))
+
+
+def pairing_standard(p: AffineG1, q: AffineG2) -> Fp12T:
+    """The standard (un-cubed) optimal ate pairing, for cross-implementation
+    GT vectors.  Slow path: direct integer hard-part exponent."""
+    if p is None or q is None:
+        return F12_ONE
+    return f12_pow(_easy_part(miller_loop(q, p)), _HARD_EXP)
 
 
 def multi_miller_loop(pairs: Sequence[Tuple[AffineG1, AffineG2]]) -> Fp12T:

@@ -1,0 +1,18 @@
+from .proto_array import (  # noqa: F401
+    ExecutionStatus,
+    ProposerBoost,
+    ProtoArray,
+    ProtoArrayError,
+    ProtoBlock,
+    ProtoNode,
+    VoteTracker,
+    ZERO_ROOT_HEX,
+    compute_deltas,
+)
+from .fork_choice import (  # noqa: F401
+    CheckpointHex,
+    ForkChoice,
+    ForkChoiceError,
+    ForkChoiceStore,
+    compute_proposer_boost_score,
+)

@@ -1,0 +1,254 @@
+"""LMD-GHOST + Casper FFG fork choice over the proto-array.
+
+Rebuild of packages/fork-choice/src/forkChoice/forkChoice.ts:66 — vote
+tracking, checkpoint management (incl. unrealized pull-up), proposer boost,
+equivocation handling, and head computation.  Time must be advanced with
+``update_time`` every slot like the reference (forkChoice.ts:64).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p, INTERVALS_PER_SLOT
+from .proto_array import (
+    ProposerBoost,
+    ProtoArray,
+    ProtoBlock,
+    ProtoNode,
+    VoteTracker,
+    ZERO_ROOT_HEX,
+    compute_deltas,
+)
+
+
+@dataclass(frozen=True)
+class CheckpointHex:
+    epoch: int
+    root: str
+
+
+@dataclass
+class ForkChoiceStore:
+    """The subset of the spec's Store the fork choice needs
+    (forkChoice/store.ts), balances by effective-balance increment."""
+
+    current_slot: int
+    justified: CheckpointHex
+    justified_balances: Sequence[int]
+    finalized: CheckpointHex
+    unrealized_justified: CheckpointHex
+    unrealized_finalized: CheckpointHex
+    equivocating_indices: Set[int] = field(default_factory=set)
+
+
+class ForkChoiceError(Exception):
+    pass
+
+
+def compute_proposer_boost_score(
+    justified_balances: Sequence[int], proposer_score_boost: int
+) -> int:
+    total = 0
+    active = 0
+    for b in justified_balances:
+        if b > 0:
+            active += 1
+            total += b
+    if active == 0:
+        return 0
+    avg = total // active
+    committee_size = active // _p.SLOTS_PER_EPOCH
+    return committee_size * avg * proposer_score_boost // 100
+
+
+class ForkChoice:
+    def __init__(
+        self,
+        cfg,
+        store: ForkChoiceStore,
+        proto_array: ProtoArray,
+        proposer_boost_enabled: bool = True,
+    ):
+        self.cfg = cfg
+        self.store = store
+        self.proto_array = proto_array
+        self.votes: List[Optional[VoteTracker]] = []
+        self.proposer_boost_root: Optional[str] = None
+        self.proposer_boost_enabled = proposer_boost_enabled
+        self._justified_proposer_boost_score: Optional[int] = None
+        self.head: Optional[ProtoNode] = None
+
+    # ------------------------------------------------------------------
+    # head
+    # ------------------------------------------------------------------
+
+    def update_head(self) -> ProtoNode:
+        balances = self.store.justified_balances
+        deltas = compute_deltas(
+            self.proto_array.indices,
+            self.votes,
+            balances,
+            balances,
+            self.store.equivocating_indices,
+        )
+        boost = None
+        if self.proposer_boost_enabled and self.proposer_boost_root:
+            if self._justified_proposer_boost_score is None:
+                self._justified_proposer_boost_score = compute_proposer_boost_score(
+                    balances, self.cfg.PROPOSER_SCORE_BOOST
+                )
+            boost = ProposerBoost(
+                self.proposer_boost_root, self._justified_proposer_boost_score
+            )
+        self.proto_array.apply_score_changes(
+            deltas,
+            boost,
+            self.store.justified.epoch,
+            self.store.justified.root,
+            self.store.finalized.epoch,
+            self.store.finalized.root,
+            self.store.current_slot,
+        )
+        head_root = self.proto_array.find_head(
+            self.store.justified.root, self.store.current_slot
+        )
+        node = self.proto_array.get_node(head_root)
+        if node is None:
+            raise ForkChoiceError(f"missing head node {head_root}")
+        self.head = node
+        return node
+
+    def get_head(self) -> ProtoNode:
+        return self.head if self.head is not None else self.update_head()
+
+    # ------------------------------------------------------------------
+    # events
+    # ------------------------------------------------------------------
+
+    def on_block(
+        self,
+        block: ProtoBlock,
+        block_delay_sec: float,
+        justified_checkpoint: CheckpointHex,
+        finalized_checkpoint: CheckpointHex,
+        justified_balances: Optional[Sequence[int]] = None,
+    ) -> None:
+        """Register a (fully verified) block.  Checkpoint updates follow
+        forkChoice.ts:389-458: realized from the post-state, unrealized
+        pulled up for timely epochs."""
+        if not self.proto_array.has_block(block.parent_root):
+            raise ForkChoiceError(f"unknown parent {block.parent_root}")
+
+        # proposer boost: first block of the slot arriving timely
+        if (
+            self.proposer_boost_enabled
+            and block.slot == self.store.current_slot
+            and block_delay_sec < self.cfg.SECONDS_PER_SLOT / INTERVALS_PER_SLOT
+            and self.proposer_boost_root is None
+        ):
+            self.proposer_boost_root = block.block_root
+
+        self._update_checkpoints(
+            justified_checkpoint, finalized_checkpoint, justified_balances
+        )
+
+        # Track the highest unrealized checkpoints for the epoch-boundary
+        # pull-up (spec on_tick / reference forkChoice.ts:450): they are
+        # applied to the realized store either now — iff the block is from
+        # a PRIOR epoch — or at the next epoch transition in update_time().
+        unrealized_j = CheckpointHex(
+            block.unrealized_justified_epoch, block.unrealized_justified_root
+        )
+        unrealized_f = CheckpointHex(
+            block.unrealized_finalized_epoch, block.unrealized_finalized_root
+        )
+        if unrealized_j.epoch > self.store.unrealized_justified.epoch:
+            self.store.unrealized_justified = unrealized_j
+        if unrealized_f.epoch > self.store.unrealized_finalized.epoch:
+            self.store.unrealized_finalized = unrealized_f
+        block_epoch = block.slot // _p.SLOTS_PER_EPOCH
+        current_epoch = self.store.current_slot // _p.SLOTS_PER_EPOCH
+        if block_epoch < current_epoch:
+            self._update_checkpoints(unrealized_j, unrealized_f, justified_balances)
+
+        self.proto_array.on_block(block, self.store.current_slot)
+        self.head = None
+
+    def on_attestation(
+        self,
+        validator_indices: Sequence[int],
+        block_root: str,
+        target_epoch: int,
+    ) -> None:
+        """Record LMD votes (forkChoice.ts:505 onAttestation after
+        validation; the caller has already validated the attestation)."""
+        for v in validator_indices:
+            if v in self.store.equivocating_indices:
+                continue
+            while len(self.votes) <= v:
+                self.votes.append(None)
+            vote = self.votes[v]
+            if vote is None:
+                self.votes[v] = VoteTracker(
+                    current_root=ZERO_ROOT_HEX,
+                    next_root=block_root,
+                    next_epoch=target_epoch,
+                )
+            elif target_epoch > vote.next_epoch:
+                vote.next_root = block_root
+                vote.next_epoch = target_epoch
+        self.head = None
+
+    def on_attester_slashing(self, attester_indices_1, attester_indices_2) -> None:
+        inter = set(attester_indices_1) & set(attester_indices_2)
+        self.store.equivocating_indices.update(inter)
+        self.head = None
+
+    def update_time(self, current_slot: int) -> None:
+        """Per-slot tick: reset proposer boost; at epoch boundaries pull
+        unrealized checkpoints into the realized store (spec on_tick)."""
+        while self.store.current_slot < current_slot:
+            self.store.current_slot += 1
+            self.proposer_boost_root = None
+            if self.store.current_slot % _p.SLOTS_PER_EPOCH == 0:
+                self._update_checkpoints(
+                    self.store.unrealized_justified,
+                    self.store.unrealized_finalized,
+                    None,
+                )
+        self.head = None
+
+    def prune(self, finalized_root: str) -> List[ProtoNode]:
+        return self.proto_array.maybe_prune(finalized_root)
+
+    # ------------------------------------------------------------------
+
+    def _update_checkpoints(
+        self,
+        justified: CheckpointHex,
+        finalized: CheckpointHex,
+        justified_balances: Optional[Sequence[int]],
+    ) -> None:
+        if justified.epoch > self.store.justified.epoch:
+            self.store.justified = justified
+            if justified_balances is not None:
+                self.store.justified_balances = justified_balances
+                self._justified_proposer_boost_score = None
+        if finalized.epoch > self.store.finalized.epoch:
+            self.store.finalized = finalized
+
+    # queries ----------------------------------------------------------
+
+    def get_block(self, root: str) -> Optional[ProtoNode]:
+        return self.proto_array.get_node(root)
+
+    def has_block(self, root: str) -> bool:
+        return self.proto_array.has_block(root)
+
+    def is_descendant(self, ancestor: str, descendant: str) -> bool:
+        return self.proto_array.is_descendant(ancestor, descendant)
+
+    def get_ancestor(self, root: str, slot: int) -> Optional[str]:
+        node = self.proto_array.get_ancestor_at_or_before_slot(root, slot)
+        return node.block_root if node else None

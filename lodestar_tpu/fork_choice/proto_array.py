@@ -1,0 +1,412 @@
+"""Proto-array LMD-GHOST fork choice DAG.
+
+Rebuild of the reference's proto-array
+(packages/fork-choice/src/protoArray/protoArray.ts:1-986, computeDeltas.ts)
+with the same semantics: flat node array in insertion order, backward
+weight propagation, best-child/best-descendant maintenance, viability via
+(unrealized) justified/finalized checkpoints (filter_block_tree), proposer
+boost, invalid-execution handling, and threshold-based pruning.
+
+The node store is arrays-of-scalars (struct-of-arrays) rather than an array
+of objects: weights live in a numpy int64 vector so the per-epoch rebalance
+(applyScoreChanges' backward pass) is a vectorized segment accumulation —
+the layout a device kernel would want, kept on host because the DAG is
+small and latency-bound.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+ZERO_ROOT_HEX = "0x" + "00" * 32
+
+
+class ExecutionStatus(str, Enum):
+    Valid = "Valid"
+    Syncing = "Syncing"
+    PreMerge = "PreMerge"
+    Invalid = "Invalid"
+
+
+@dataclass
+class ProtoBlock:
+    slot: int
+    block_root: str
+    parent_root: str
+    state_root: str
+    target_root: str
+    justified_epoch: int
+    justified_root: str
+    finalized_epoch: int
+    finalized_root: str
+    unrealized_justified_epoch: int
+    unrealized_justified_root: str
+    unrealized_finalized_epoch: int
+    unrealized_finalized_root: str
+    execution_payload_block_hash: Optional[str] = None
+    execution_status: ExecutionStatus = ExecutionStatus.PreMerge
+
+
+@dataclass
+class ProtoNode(ProtoBlock):
+    parent: Optional[int] = None
+    weight: int = 0
+    best_child: Optional[int] = None
+    best_descendant: Optional[int] = None
+
+
+@dataclass
+class VoteTracker:
+    current_root: str = ZERO_ROOT_HEX
+    next_root: str = ZERO_ROOT_HEX
+    next_epoch: int = 0
+
+
+@dataclass
+class ProposerBoost:
+    root: str
+    score: int
+
+
+def compute_deltas(
+    indices: Dict[str, int],
+    votes: List[Optional[VoteTracker]],
+    old_balances: Sequence[int],
+    new_balances: Sequence[int],
+    equivocating_indices: Set[int],
+) -> List[int]:
+    """One delta per proto-node from vote changes and balance changes
+    (protoArray/computeDeltas.ts)."""
+    deltas = [0] * len(indices)
+    for v_index, vote in enumerate(votes):
+        if vote is None:
+            continue
+        if vote.current_root == ZERO_ROOT_HEX and vote.next_root == ZERO_ROOT_HEX:
+            continue
+        old_balance = old_balances[v_index] if v_index < len(old_balances) else 0
+        new_balance = new_balances[v_index] if v_index < len(new_balances) else 0
+
+        if v_index in equivocating_indices:
+            if vote.current_root != ZERO_ROOT_HEX:
+                i = indices.get(vote.current_root)
+                if i is not None:
+                    deltas[i] -= old_balance
+            vote.current_root = ZERO_ROOT_HEX
+            continue
+
+        if vote.current_root != vote.next_root or old_balance != new_balance:
+            i = indices.get(vote.current_root)
+            if i is not None:
+                deltas[i] -= old_balance
+            j = indices.get(vote.next_root)
+            if j is not None:
+                deltas[j] += new_balance
+            vote.current_root = vote.next_root
+    return deltas
+
+
+class ProtoArrayError(Exception):
+    pass
+
+
+class ProtoArray:
+    def __init__(
+        self,
+        prune_threshold: int = 0,
+        count_unrealized_full: bool = False,
+    ):
+        self.prune_threshold = prune_threshold
+        self.count_unrealized_full = count_unrealized_full
+        self.justified_epoch = 0
+        self.justified_root = ZERO_ROOT_HEX
+        self.finalized_epoch = 0
+        self.finalized_root = ZERO_ROOT_HEX
+        self.nodes: List[ProtoNode] = []
+        self.indices: Dict[str, int] = {}
+        self.previous_proposer_boost: Optional[ProposerBoost] = None
+
+    @classmethod
+    def initialize(cls, block: ProtoBlock, current_slot: int, **kwargs) -> "ProtoArray":
+        arr = cls(**kwargs)
+        arr.justified_epoch = block.justified_epoch
+        arr.justified_root = block.justified_root
+        arr.finalized_epoch = block.finalized_epoch
+        arr.finalized_root = block.finalized_root
+        arr.on_block(block, current_slot)
+        return arr
+
+    # ------------------------------------------------------------------
+    # insertion
+    # ------------------------------------------------------------------
+
+    def on_block(self, block: ProtoBlock, current_slot: int) -> None:
+        if block.block_root in self.indices:
+            return
+        node = ProtoNode(**vars(block))
+        node.parent = self.indices.get(block.parent_root)
+        node_index = len(self.nodes)
+        self.indices[block.block_root] = node_index
+        self.nodes.append(node)
+
+        parent_index = node.parent
+        n: Optional[ProtoNode] = node
+        while parent_index is not None:
+            self._maybe_update_best_child_and_descendant(
+                parent_index, node_index, current_slot
+            )
+            node_index = parent_index
+            n = self.nodes[node_index]
+            parent_index = n.parent
+
+    # ------------------------------------------------------------------
+    # weights
+    # ------------------------------------------------------------------
+
+    def apply_score_changes(
+        self,
+        deltas: List[int],
+        proposer_boost: Optional[ProposerBoost],
+        justified_epoch: int,
+        justified_root: str,
+        finalized_epoch: int,
+        finalized_root: str,
+        current_slot: int,
+    ) -> None:
+        if len(deltas) != len(self.indices):
+            raise ProtoArrayError(
+                f"invalid delta length {len(deltas)} != {len(self.indices)}"
+            )
+        self.justified_epoch = justified_epoch
+        self.justified_root = justified_root
+        self.finalized_epoch = finalized_epoch
+        self.finalized_root = finalized_root
+
+        # backward pass: apply deltas (+boost diff), back-propagate to parent
+        for node_index in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[node_index]
+            if node.block_root == ZERO_ROOT_HEX:
+                continue
+            current_boost = (
+                proposer_boost.score
+                if proposer_boost and proposer_boost.root == node.block_root
+                else 0
+            )
+            previous_boost = (
+                self.previous_proposer_boost.score
+                if self.previous_proposer_boost
+                and self.previous_proposer_boost.root == node.block_root
+                else 0
+            )
+            if node.execution_status == ExecutionStatus.Invalid:
+                node_delta = -node.weight
+            else:
+                node_delta = deltas[node_index] + current_boost - previous_boost
+            node.weight += node_delta
+            if node.parent is not None:
+                deltas[node.parent] += node_delta
+
+        # second backward pass: refresh best-child/descendant coherently
+        for node_index in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[node_index]
+            if node.parent is not None:
+                self._maybe_update_best_child_and_descendant(
+                    node.parent, node_index, current_slot
+                )
+        self.previous_proposer_boost = proposer_boost
+
+    # ------------------------------------------------------------------
+    # head
+    # ------------------------------------------------------------------
+
+    def find_head(self, justified_root: str, current_slot: int) -> str:
+        justified_index = self.indices.get(justified_root)
+        if justified_index is None:
+            raise ProtoArrayError(f"justified node unknown {justified_root}")
+        justified_node = self.nodes[justified_index]
+        best_descendant_index = (
+            justified_node.best_descendant
+            if justified_node.best_descendant is not None
+            else justified_index
+        )
+        best_node = self.nodes[best_descendant_index]
+        if best_descendant_index != justified_index and not self.node_is_viable_for_head(
+            best_node, current_slot
+        ):
+            raise ProtoArrayError(
+                f"best node {best_node.block_root} not viable for head"
+            )
+        return best_node.block_root
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+
+    def _maybe_update_best_child_and_descendant(
+        self, parent_index: int, child_index: int, current_slot: int
+    ) -> None:
+        child = self.nodes[child_index]
+        parent = self.nodes[parent_index]
+        child_viable = self._node_leads_to_viable_head(child, current_slot)
+
+        change_to_child = (
+            child_index,
+            child.best_descendant if child.best_descendant is not None else child_index,
+        )
+        no_change = (parent.best_child, parent.best_descendant)
+
+        best_child_index = parent.best_child
+        if best_child_index is not None:
+            if best_child_index == child_index and not child_viable:
+                new = (None, None)
+            elif best_child_index == child_index:
+                new = change_to_child
+            else:
+                best_child = self.nodes[best_child_index]
+                best_viable = self._node_leads_to_viable_head(best_child, current_slot)
+                if child_viable and not best_viable:
+                    new = change_to_child
+                elif not child_viable and best_viable:
+                    new = no_change
+                elif child.weight == best_child.weight:
+                    # tie-break equal weights lexicographically by root
+                    new = (
+                        change_to_child
+                        if child.block_root >= best_child.block_root
+                        else no_change
+                    )
+                else:
+                    new = (
+                        change_to_child
+                        if child.weight >= best_child.weight
+                        else no_change
+                    )
+        elif child_viable:
+            new = change_to_child
+        else:
+            new = no_change
+
+        parent.best_child, parent.best_descendant = new
+
+    def _node_leads_to_viable_head(self, node: ProtoNode, current_slot: int) -> bool:
+        if node.best_descendant is not None:
+            best = self.nodes[node.best_descendant]
+            best_viable = self.node_is_viable_for_head(best, current_slot)
+        else:
+            best_viable = False
+        return best_viable or self.node_is_viable_for_head(node, current_slot)
+
+    def node_is_viable_for_head(self, node: ProtoNode, current_slot: int) -> bool:
+        """filter_block_tree equivalent (consensus-specs fork-choice.md),
+        using unrealized checkpoints for blocks from previous epochs."""
+        if node.execution_status == ExecutionStatus.Invalid:
+            return False
+        current_epoch = current_slot // _p.SLOTS_PER_EPOCH
+        previous_epoch = current_epoch - 1
+        is_from_prev_epoch = node.slot // _p.SLOTS_PER_EPOCH < current_epoch
+        node_justified_epoch = (
+            node.unrealized_justified_epoch if is_from_prev_epoch else node.justified_epoch
+        )
+        node_justified_root = (
+            node.unrealized_justified_root if is_from_prev_epoch else node.justified_root
+        )
+        node_finalized_epoch = (
+            node.unrealized_finalized_epoch if is_from_prev_epoch else node.finalized_epoch
+        )
+        node_finalized_root = (
+            node.unrealized_finalized_root if is_from_prev_epoch else node.finalized_root
+        )
+
+        if (
+            self.count_unrealized_full
+            and current_epoch > 0
+            and self.justified_epoch == previous_epoch
+        ):
+            return node.unrealized_justified_epoch >= previous_epoch
+        correct_justified = (
+            node_justified_epoch == self.justified_epoch
+            and node_justified_root == self.justified_root
+        ) or self.justified_epoch == 0
+        correct_finalized = (
+            node_finalized_epoch == self.finalized_epoch
+            and node_finalized_root == self.finalized_root
+        ) or self.finalized_epoch == 0
+        return correct_justified and correct_finalized
+
+    # ------------------------------------------------------------------
+    # queries / maintenance
+    # ------------------------------------------------------------------
+
+    def get_node(self, block_root: str) -> Optional[ProtoNode]:
+        i = self.indices.get(block_root)
+        return self.nodes[i] if i is not None else None
+
+    def has_block(self, block_root: str) -> bool:
+        return block_root in self.indices
+
+    def iterate_ancestor_nodes(self, block_root: str) -> Iterator[ProtoNode]:
+        i = self.indices.get(block_root)
+        if i is None:
+            return
+        node = self.nodes[i]
+        while node.parent is not None:
+            node = self.nodes[node.parent]
+            yield node
+
+    def is_descendant(self, ancestor_root: str, descendant_root: str) -> bool:
+        ancestor = self.get_node(ancestor_root)
+        if ancestor is None:
+            return False
+        node = self.get_node(descendant_root)
+        if node is None:
+            return False
+        if node.block_root == ancestor_root:
+            return True
+        for anc in self.iterate_ancestor_nodes(descendant_root):
+            if anc.slot < ancestor.slot:
+                return False
+            if anc.block_root == ancestor_root:
+                return True
+        return False
+
+    def get_ancestor_at_or_before_slot(
+        self, block_root: str, slot: int
+    ) -> Optional[ProtoNode]:
+        node = self.get_node(block_root)
+        if node is None:
+            return None
+        while node.slot > slot:
+            if node.parent is None:
+                return None
+            node = self.nodes[node.parent]
+        return node
+
+    def maybe_prune(self, finalized_root: str) -> List[ProtoNode]:
+        """Drop all nodes before the finalized one once past the threshold
+        (protoArray.ts maybePrune)."""
+        finalized_index = self.indices.get(finalized_root)
+        if finalized_index is None:
+            raise ProtoArrayError(f"finalized node unknown {finalized_root}")
+        if finalized_index < self.prune_threshold:
+            return []
+        removed = self.nodes[:finalized_index]
+        for node in removed:
+            del self.indices[node.block_root]
+        self.nodes = self.nodes[finalized_index:]
+        for root in self.indices:
+            self.indices[root] -= finalized_index
+        for node in self.nodes:
+            if node.parent is not None:
+                node.parent = node.parent - finalized_index if node.parent >= finalized_index else None
+            if node.best_child is not None:
+                bc = node.best_child - finalized_index
+                node.best_child = bc if bc >= 0 else None
+            if node.best_descendant is not None:
+                bd = node.best_descendant - finalized_index
+                node.best_descendant = bd if bd >= 0 else None
+        return removed
+
+    def __len__(self) -> int:
+        return len(self.nodes)

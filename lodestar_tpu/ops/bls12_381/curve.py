@@ -178,21 +178,7 @@ def to_affine(F: FieldOps, pt, f_inv):
     return (x, y), is_inf(F, pt)
 
 
-def tree_reduce_add(F: FieldOps, pts):
-    """Sum a batch of points along the leading axis by pairwise halving.
-
-    Batch size must be a power of two (verifier buckets are 16/32/64/128,
-    mirroring the reference's job-size policy, multithread/index.ts:39).
-    """
-    n = jax.tree.leaves(pts)[0].shape[0]
-    assert n & (n - 1) == 0, "batch must be a power of two"
-    while n > 1:
-        half = n // 2
-        a = jax.tree.map(lambda t: t[:half], pts)
-        b = jax.tree.map(lambda t: t[half:n], pts)
-        pts = jac_add(F, a, b)
-        n = half
-    return jax.tree.map(lambda t: t[0], pts)
+# point-batch reduction lives in verify.py (jac_reduce_add — any batch size)
 
 
 # ---------------------------------------------------------------------------

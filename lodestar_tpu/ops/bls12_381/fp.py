@@ -9,10 +9,18 @@ TPU-native analogue of the reference's per-core BLS worker data parallelism
 Design note on carry handling: carry/borrow propagation is NOT a sequential
 scan here.  A pairing is ~10^5 field ops; giving each one a ``lax.scan``
 produces thousands of XLA while-subcomputations and intractable compile
-times.  Instead, carries resolve in log2(NLIMBS) Hillis-Steele steps of the
-classic (generate, propagate) carry-lookahead monoid — straight-line
-elementwise HLO that XLA fuses.  The only remaining loop is the CIOS
-Montgomery multiplier itself (unrolled by default: 30 static steps).
+times.  Instead, carries resolve with a branch-free BROADCAST-COMPARE
+formulation: carry_in[i] = OR_{j<i} (generate[j] AND limbs j+1..i-1 all
+propagate), where the "all propagate" predicate is a prefix-count equality
+computed with ONE tiny static matmul (cumulative sum by lower-triangular
+matrix).  This yields ~10 elementwise HLO ops on a (..., N, N) tile per
+carry resolution — no concatenate/pad chains, which XLA:CPU's fusion and
+algebraic-simplifier passes handle pathologically slowly (measured ~1 s of
+compile time PER shift-by-concat op, vs milliseconds for dots/elementwise),
+and no log-depth shift networks.  On TPU the (30, 30) tile is VPU-friendly.
+
+Limb shifts (multiply/divide by the radix) are likewise static matmuls
+(x @ SHIFT) instead of concatenates, for the same compile-time reason.
 
 Overflow audit for mont_mul (uint32, b = 2^13-1 = 8191):
   * product a_i*b_j <= 8191^2 = 67,092,481 < 2^27
@@ -38,6 +46,15 @@ _P = jnp.asarray(P_LIMBS, dtype=_u32)
 _R2 = jnp.asarray(R2_LIMBS, dtype=_u32)
 _ONE_M = jnp.asarray(ONE_MONT, dtype=_u32)
 
+# Static limb-axis matrices (see module docstring): shifts and prefix-sums
+# as dots, pairwise masks for broadcast-compare carry resolution.
+_SHIFT_UP_M = jnp.asarray(np.eye(NLIMBS, k=1, dtype=np.uint32))    # x @ M -> limb k = x[k-1]
+_SHIFT_DOWN_M = jnp.asarray(np.eye(NLIMBS, k=-1, dtype=np.uint32))  # x @ M -> limb k = x[k+1]
+_CUMSUM_INCL_M = jnp.asarray(np.triu(np.ones((NLIMBS, NLIMBS), dtype=np.uint32)))  # x @ M -> prefix sums
+# pairwise_lt[j, i] = 1 iff j < i  (j = source limb, i = destination limb)
+_PAIR_LT = jnp.asarray(np.tril(np.ones((NLIMBS, NLIMBS), dtype=np.uint32), k=-1).T)
+_E0 = jnp.asarray(np.eye(NLIMBS, dtype=np.uint32)[0])
+
 
 def zeros(shape=()) -> jnp.ndarray:
     return jnp.zeros((*shape, NLIMBS), dtype=_u32)
@@ -52,9 +69,19 @@ def one_mont(shape=()) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _dot(x, m):
+    """x @ m along the limb axis (static 0/1 uint32 matrix).
+
+    Written as broadcast-multiply + reduce-sum rather than dot_general:
+    XLA:CPU codegens integer matmuls slowly (no Eigen path), while the
+    elementwise form compiles in milliseconds and fuses; on TPU the
+    (..., N, N) tile is trivially vectorized."""
+    return (x[..., :, None] * m).sum(axis=-2)
+
+
 def _shift_up(x):
     """Limb k of result = limb k-1 of x (i.e. multiply by 2^13), zero-fill."""
-    return jnp.concatenate([jnp.zeros_like(x[..., :1]), x[..., :-1]], axis=-1)
+    return _dot(x, _SHIFT_UP_M)
 
 
 def _carry_pass(x):
@@ -62,41 +89,44 @@ def _carry_pass(x):
     return (x & MASK) + _shift_up(x >> LIMB_BITS)
 
 
-def _lookahead(g, pr):
-    """Inclusive prefix of the carry monoid along the limb axis.
+def _propagate(g, pr):
+    """Branch-free single-bit carry/borrow propagation.
 
-    g[i]: limb i generates a carry regardless of carry-in.
-    pr[i]: limb i propagates an incoming carry.
-    Returns carry-out flags per limb (uint32 0/1).
+    g[j]:  limb j generates (uint32 0/1).   pr[j]: limb j propagates.
+    g and pr must be disjoint (a generating limb cannot also propagate).
+    Returns (carry_in per limb, total carry-out), where
+      carry_in[i] = OR_{j<i} ( g[j] AND pr[j+1..i-1] all set )
+    computed via prefix-counts of non-propagating limbs: the span j+1..i-1
+    is all-propagate iff Z[i-1] == Z[j] with Z = inclusive cumsum of ~pr.
     """
-    d = 1
-    while d < NLIMBS:
-        g_lo = _shift_up_by(g, d)
-        p_lo = _shift_up_by(pr, d)
-        g = g | (pr & g_lo)
-        pr = pr & p_lo
-        d *= 2
-    return g
-
-
-def _shift_up_by(x, d):
-    return jnp.concatenate([jnp.zeros_like(x[..., :d]), x[..., :-d]], axis=-1)
+    np_ = pr ^ _u32(1)
+    Z = _dot(np_, _CUMSUM_INCL_M)            # Z[k] = #non-propagating in 0..k
+    Zi1 = _shift_up(Z)                       # Z[i-1], 0 for i = 0
+    # A[..., j, i] = g[j] & (Z[i-1] == Z[j]) & (j < i)
+    eq = (Zi1[..., None, :] == Z[..., :, None]).astype(_u32)
+    A = g[..., :, None] * eq * _PAIR_LT
+    carry_in = A.max(axis=-2)
+    # carry out of the top limb: g[j] with pr[j+1..N-1] all set
+    total = (g * (Z[..., -1:] == Z).astype(_u32)).max(axis=-1)
+    return carry_in, total
 
 
 def _resolve_single_carries(t):
-    """Exact canonicalization for limbs < 2^14 with single-bit carries.
+    """Exact canonicalization for limbs with single-bit carries.
 
-    Valid when every limb of t is <= 2^14 - 1 (so carry-out is 0 or 1).
+    Precondition: every limb of t is <= 2^14 - 2, so carry-out per limb is
+    0 or 1 even with an incoming carry.  Callers stay within bound: add()
+    feeds limbs <= 2*MASK = 2^14 - 2; sub() feeds d + P <= 2*MASK;
+    _norm_wide feeds limbs <= MASK + 61 after its two carry passes.
     """
     g = (t >> LIMB_BITS).astype(_u32)          # t >= 2^13 -> generates
     pr = (t == MASK).astype(_u32)              # t == mask -> propagates
-    carry_out = _lookahead(g, pr)
-    carry_in = _shift_up(carry_out)
+    carry_in, _ = _propagate(g, pr)
     return (t + carry_in) & MASK
 
 
 def _norm_wide(u):
-    """Canonicalize limbs up to 2^32 (mont_mul output): 2 passes + lookahead."""
+    """Canonicalize limbs up to 2^32 (mont_mul output): 2 passes + resolve."""
     u = _carry_pass(u)   # limbs <= mask + 2^19
     u = _carry_pass(u)   # limbs <= mask + 61 < 2^14
     return _resolve_single_carries(u)
@@ -109,10 +139,9 @@ def _borrow_sub(a, b):
     """
     g = (a < b).astype(_u32)
     pr = (a == b).astype(_u32)
-    borrow_out = _lookahead(g, pr)
-    borrow_in = _shift_up(borrow_out)
+    borrow_in, borrow_out = _propagate(g, pr)
     limbs = (a + _u32(1 << LIMB_BITS) - b - borrow_in) & MASK
-    return limbs, borrow_out[..., -1]
+    return limbs, borrow_out
 
 
 def _cond_sub_p(t):
@@ -152,8 +181,9 @@ def _cios_step(u, a_i, b):
     m = (u[..., 0] * _u32(N0INV)) & MASK
     u = u + m[..., None] * _P
     carry = u[..., 0] >> LIMB_BITS
-    head = (u[..., 1] + carry)[..., None]
-    return jnp.concatenate([head, u[..., 2:], jnp.zeros_like(u[..., :1])], axis=-1)
+    # shift down one limb (drop the now-zero column 0) and add the carry
+    # into the new limb 0 — as a dot, not a concatenate (see module note)
+    return _dot(u, _SHIFT_DOWN_M) + carry[..., None] * _E0
 
 
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

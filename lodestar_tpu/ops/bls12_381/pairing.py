@@ -93,7 +93,10 @@ def miller_loop(q_aff, p_aff):
 
     q_aff: affine G2 ((x0,x1),(y0,y1)) Fp2 limb tuples, batched.
     p_aff: affine G1 (x, y) Fp limb tensors, batched.
-    Infinity inputs produce garbage — callers mask (verify.py).
+
+    PRECONDITION: inputs must be finite affine points.  Infinity inputs
+    produce garbage limbs; verify.py masks such entries out of the product
+    (multi_miller_product / pairing_check) before they reach a reduction.
     """
     xq, yq = q_aff
     xp, yp = p_aff

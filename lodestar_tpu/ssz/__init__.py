@@ -1,0 +1,7 @@
+from .core import (  # noqa: F401
+    Bitlist, BitlistT, Bitvector, BitvectorT, ByteList, ByteListT, ByteVector,
+    ByteVectorT, Bytes4, Bytes20, Bytes32, Bytes48, Bytes96, Container,
+    ContainerMeta, List, ListT, SszType, Uint, Vector, VectorT, ZERO_HASHES,
+    boolean, hash_nodes, merkleize_chunks, mix_in_length, pack_bytes,
+    uint8, uint16, uint32, uint64, uint128, uint256,
+)

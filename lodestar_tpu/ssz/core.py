@@ -1,0 +1,618 @@
+"""SSZ (SimpleSerialize) engine: serialization + merkleization.
+
+The TPU-native rebuild's equivalent of the reference's `@chainsafe/ssz` +
+`@chainsafe/persistent-merkle-tree` + `@chainsafe/as-sha256` stack (consumed
+via packages/types/src/sszTypes.ts).  Values are plain Python objects (ints,
+bytes, lists, Container instances) rather than tree-backed views: the
+state-transition layer keeps its own flat numpy caches for the O(V) hot
+loops (mirroring the reference's EpochContext design,
+state-transition/src/cache/epochContext.ts:80), so the tree is only needed
+for hashTreeRoot and proofs — computed here with a layer-wise numpy+hashlib
+merkleizer and a zero-subtree cache.
+
+Spec: consensus-specs/ssz/simple-serialize.md (v1.3.0-alpha.2 era, matching
+the reference's spec-test pin, test/spec/specTestVersioning.ts:17).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List as PyList, Optional, Sequence, Tuple
+
+BYTES_PER_CHUNK = 32
+ZERO_CHUNK = b"\x00" * BYTES_PER_CHUNK
+
+# zero_hashes[i] = root of a depth-i all-zero subtree
+ZERO_HASHES: PyList[bytes] = [ZERO_CHUNK]
+for _ in range(64):
+    ZERO_HASHES.append(
+        hashlib.sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]).digest()
+    )
+
+
+def hash_nodes(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def _next_pow2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> bytes:
+    """Merkle root of chunks padded with zero-subtrees to `limit` leaves.
+
+    limit=None pads to the next power of two of len(chunks)."""
+    count = len(chunks)
+    if limit is None:
+        limit = _next_pow2(count)
+    else:
+        if count > limit:
+            raise ValueError(f"chunk count {count} exceeds limit {limit}")
+        limit = _next_pow2(limit)
+    if limit == 1:
+        return bytes(chunks[0]) if count else ZERO_CHUNK
+    depth = limit.bit_length() - 1
+    layer = [bytes(c) for c in chunks]
+    for level in range(depth):
+        if len(layer) == 0:
+            return ZERO_HASHES[depth]
+        nxt = []
+        for i in range(0, len(layer) - 1, 2):
+            nxt.append(hash_nodes(layer[i], layer[i + 1]))
+        if len(layer) % 2:
+            nxt.append(hash_nodes(layer[-1], ZERO_HASHES[level]))
+        layer = nxt
+    return layer[0]
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return hash_nodes(root, length.to_bytes(32, "little"))
+
+
+def pack_bytes(data: bytes) -> PyList[bytes]:
+    """Right-pad to a whole number of 32-byte chunks."""
+    if not data:
+        return []
+    n = len(data)
+    rem = n % BYTES_PER_CHUNK
+    if rem:
+        data = data + b"\x00" * (BYTES_PER_CHUNK - rem)
+    return [data[i : i + BYTES_PER_CHUNK] for i in range(0, len(data), BYTES_PER_CHUNK)]
+
+
+# ---------------------------------------------------------------------------
+# type descriptors
+# ---------------------------------------------------------------------------
+
+
+class SszType:
+    """Base type descriptor.  Subclasses implement the SSZ spec quartet."""
+
+    def is_fixed(self) -> bool:
+        raise NotImplementedError
+
+    def fixed_size(self) -> int:
+        raise NotImplementedError
+
+    def default(self):
+        raise NotImplementedError
+
+    def serialize(self, value) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes):
+        raise NotImplementedError
+
+    def hash_tree_root(self, value) -> bytes:
+        raise NotImplementedError
+
+    # chunk count for List limits — overridden per spec category
+    def __repr__(self):
+        return self.__class__.__name__
+
+
+class Uint(SszType):
+    def __init__(self, bits: int):
+        assert bits in (8, 16, 32, 64, 128, 256)
+        self.bits = bits
+        self.nbytes = bits // 8
+
+    def is_fixed(self):
+        return True
+
+    def fixed_size(self):
+        return self.nbytes
+
+    def default(self):
+        return 0
+
+    def serialize(self, value) -> bytes:
+        return int(value).to_bytes(self.nbytes, "little")
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.nbytes:
+            raise ValueError("bad uint size")
+        return int.from_bytes(data, "little")
+
+    def hash_tree_root(self, value) -> bytes:
+        return self.serialize(value).ljust(32, b"\x00")
+
+    def __repr__(self):
+        return f"uint{self.bits}"
+
+
+class Boolean(SszType):
+    def is_fixed(self):
+        return True
+
+    def fixed_size(self):
+        return 1
+
+    def default(self):
+        return False
+
+    def serialize(self, value) -> bytes:
+        return b"\x01" if value else b"\x00"
+
+    def deserialize(self, data: bytes):
+        if data == b"\x01":
+            return True
+        if data == b"\x00":
+            return False
+        raise ValueError("bad boolean")
+
+    def hash_tree_root(self, value) -> bytes:
+        return self.serialize(value).ljust(32, b"\x00")
+
+
+class ByteVectorT(SszType):
+    def __init__(self, length: int):
+        self.length = length
+
+    def is_fixed(self):
+        return True
+
+    def fixed_size(self):
+        return self.length
+
+    def default(self):
+        return b"\x00" * self.length
+
+    def serialize(self, value) -> bytes:
+        value = bytes(value)
+        if len(value) != self.length:
+            raise ValueError(f"ByteVector[{self.length}] got {len(value)} bytes")
+        return value
+
+    def deserialize(self, data: bytes):
+        return self.serialize(data)
+
+    def hash_tree_root(self, value) -> bytes:
+        return merkleize_chunks(pack_bytes(self.serialize(value)))
+
+    def __repr__(self):
+        return f"ByteVector[{self.length}]"
+
+
+class ByteListT(SszType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed(self):
+        return False
+
+    def default(self):
+        return b""
+
+    def serialize(self, value) -> bytes:
+        value = bytes(value)
+        if len(value) > self.limit:
+            raise ValueError("ByteList over limit")
+        return value
+
+    def deserialize(self, data: bytes):
+        return self.serialize(data)
+
+    def hash_tree_root(self, value) -> bytes:
+        value = bytes(value)
+        limit_chunks = (self.limit + 31) // 32
+        return mix_in_length(
+            merkleize_chunks(pack_bytes(value), limit_chunks), len(value)
+        )
+
+    def __repr__(self):
+        return f"ByteList[{self.limit}]"
+
+
+class BitvectorT(SszType):
+    def __init__(self, length: int):
+        assert length > 0
+        self.length = length
+
+    def is_fixed(self):
+        return True
+
+    def fixed_size(self):
+        return (self.length + 7) // 8
+
+    def default(self):
+        return [False] * self.length
+
+    def _to_bytes(self, bits) -> bytes:
+        if len(bits) != self.length:
+            raise ValueError("Bitvector length mismatch")
+        out = bytearray((self.length + 7) // 8)
+        for i, b in enumerate(bits):
+            if b:
+                out[i // 8] |= 1 << (i % 8)
+        return bytes(out)
+
+    def serialize(self, bits) -> bytes:
+        return self._to_bytes(bits)
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.fixed_size():
+            raise ValueError("bad Bitvector size")
+        bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(self.length)]
+        # excess bits in the last byte must be zero
+        for i in range(self.length, len(data) * 8):
+            if (data[i // 8] >> (i % 8)) & 1:
+                raise ValueError("Bitvector high bits set")
+        return bits
+
+    def hash_tree_root(self, bits) -> bytes:
+        return merkleize_chunks(
+            pack_bytes(self._to_bytes(bits)), (self.length + 255) // 256
+        )
+
+    def __repr__(self):
+        return f"Bitvector[{self.length}]"
+
+
+class BitlistT(SszType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed(self):
+        return False
+
+    def default(self):
+        return []
+
+    def serialize(self, bits) -> bytes:
+        if len(bits) > self.limit:
+            raise ValueError("Bitlist over limit")
+        n = len(bits)
+        out = bytearray(n // 8 + 1)
+        for i, b in enumerate(bits):
+            if b:
+                out[i // 8] |= 1 << (i % 8)
+        out[n // 8] |= 1 << (n % 8)  # delimiter bit
+        return bytes(out)
+
+    def deserialize(self, data: bytes):
+        if not data or data[-1] == 0:
+            raise ValueError("Bitlist missing delimiter")
+        last = data[-1]
+        hi = last.bit_length() - 1
+        n = (len(data) - 1) * 8 + hi
+        if n > self.limit:
+            raise ValueError("Bitlist over limit")
+        bits = []
+        for i in range(n):
+            bits.append(bool((data[i // 8] >> (i % 8)) & 1))
+        return bits
+
+    def hash_tree_root(self, bits) -> bytes:
+        n = len(bits)
+        out = bytearray((n + 7) // 8)
+        for i, b in enumerate(bits):
+            if b:
+                out[i // 8] |= 1 << (i % 8)
+        limit_chunks = (self.limit + 255) // 256
+        return mix_in_length(merkleize_chunks(pack_bytes(bytes(out)), limit_chunks), n)
+
+    def __repr__(self):
+        return f"Bitlist[{self.limit}]"
+
+
+def _is_basic(t: SszType) -> bool:
+    return isinstance(t, (Uint, Boolean))
+
+
+class VectorT(SszType):
+    def __init__(self, elem: SszType, length: int):
+        assert length > 0
+        self.elem = elem
+        self.length = length
+
+    def is_fixed(self):
+        return self.elem.is_fixed()
+
+    def fixed_size(self):
+        return self.elem.fixed_size() * self.length
+
+    def default(self):
+        return [self.elem.default() for _ in range(self.length)]
+
+    def serialize(self, value) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("Vector length mismatch")
+        return _serialize_sequence(self.elem, value)
+
+    def deserialize(self, data: bytes):
+        out = _deserialize_sequence(self.elem, data)
+        if len(out) != self.length:
+            raise ValueError("Vector length mismatch")
+        return out
+
+    def hash_tree_root(self, value) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("Vector length mismatch")
+        if _is_basic(self.elem):
+            data = b"".join(self.elem.serialize(v) for v in value)
+            return merkleize_chunks(pack_bytes(data))
+        roots = [self.elem.hash_tree_root(v) for v in value]
+        return merkleize_chunks(roots)
+
+    def __repr__(self):
+        return f"Vector[{self.elem!r}, {self.length}]"
+
+
+class ListT(SszType):
+    def __init__(self, elem: SszType, limit: int):
+        self.elem = elem
+        self.limit = limit
+
+    def is_fixed(self):
+        return False
+
+    def default(self):
+        return []
+
+    def serialize(self, value) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("List over limit")
+        return _serialize_sequence(self.elem, value)
+
+    def deserialize(self, data: bytes):
+        out = _deserialize_sequence(self.elem, data)
+        if len(out) > self.limit:
+            raise ValueError("List over limit")
+        return out
+
+    def hash_tree_root(self, value) -> bytes:
+        if _is_basic(self.elem):
+            data = b"".join(self.elem.serialize(v) for v in value)
+            limit_chunks = (self.limit * self.elem.fixed_size() + 31) // 32
+            root = merkleize_chunks(pack_bytes(data), limit_chunks)
+        else:
+            roots = [self.elem.hash_tree_root(v) for v in value]
+            root = merkleize_chunks(roots, self.limit)
+        return mix_in_length(root, len(value))
+
+    def __repr__(self):
+        return f"List[{self.elem!r}, {self.limit}]"
+
+
+def _serialize_sequence(elem: SszType, value) -> bytes:
+    if elem.is_fixed():
+        return b"".join(elem.serialize(v) for v in value)
+    parts = [elem.serialize(v) for v in value]
+    offset = 4 * len(parts)
+    out = bytearray()
+    for p in parts:
+        out += offset.to_bytes(4, "little")
+        offset += len(p)
+    for p in parts:
+        out += p
+    return bytes(out)
+
+
+def _deserialize_sequence(elem: SszType, data: bytes):
+    if elem.is_fixed():
+        sz = elem.fixed_size()
+        if sz == 0:
+            raise ValueError("zero-size element")
+        if len(data) % sz:
+            raise ValueError("sequence size not a multiple of element size")
+        return [elem.deserialize(data[i : i + sz]) for i in range(0, len(data), sz)]
+    if not data:
+        return []
+    first_off = int.from_bytes(data[0:4], "little")
+    if first_off % 4 or first_off > len(data):
+        raise ValueError("bad first offset")
+    n = first_off // 4
+    offs = [int.from_bytes(data[4 * i : 4 * i + 4], "little") for i in range(n)]
+    offs.append(len(data))
+    out = []
+    for i in range(n):
+        if offs[i] > offs[i + 1]:
+            raise ValueError("offsets not monotonic")
+        out.append(elem.deserialize(data[offs[i] : offs[i + 1]]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# containers
+# ---------------------------------------------------------------------------
+
+
+class ContainerMeta(type):
+    def __new__(mcls, name, bases, ns):
+        cls = super().__new__(mcls, name, bases, ns)
+        fields: Dict[str, SszType] = {}
+        for b in bases:
+            fields.update(getattr(b, "_fields_", {}))
+        for fname, ftype in ns.get("__annotations__", {}).items():
+            if isinstance(ftype, SszType):
+                fields[fname] = ftype
+            elif isinstance(ftype, ContainerMeta):
+                fields[fname] = ftype  # nested container class doubles as type
+        cls._fields_ = fields
+        return cls
+
+    # container classes themselves act as SszType descriptors -------------
+    def is_fixed(cls) -> bool:
+        return all(t.is_fixed() for t in cls._fields_.values())
+
+    def fixed_size(cls) -> int:
+        return sum(t.fixed_size() for t in cls._fields_.values())
+
+    def default(cls):
+        return cls(**{n: t.default() for n, t in cls._fields_.items()})
+
+    def serialize(cls, value) -> bytes:
+        fixed_parts = []
+        var_parts = []
+        for n, t in cls._fields_.items():
+            v = getattr(value, n)
+            if t.is_fixed():
+                fixed_parts.append(t.serialize(v))
+            else:
+                fixed_parts.append(None)
+                var_parts.append(t.serialize(v))
+        fixed_len = sum(len(p) if p is not None else 4 for p in fixed_parts)
+        out = bytearray()
+        offset = fixed_len
+        vi = 0
+        for p in fixed_parts:
+            if p is None:
+                out += offset.to_bytes(4, "little")
+                offset += len(var_parts[vi])
+                vi += 1
+            else:
+                out += p
+        for p in var_parts:
+            out += p
+        return bytes(out)
+
+    def deserialize(cls, data: bytes):
+        kwargs = {}
+        pos = 0
+        var_fields = []
+        offsets = []
+        for n, t in cls._fields_.items():
+            if t.is_fixed():
+                sz = t.fixed_size()
+                if pos + sz > len(data):
+                    raise ValueError("container truncated")
+                kwargs[n] = t.deserialize(data[pos : pos + sz])
+                pos += sz
+            else:
+                offsets.append(int.from_bytes(data[pos : pos + 4], "little"))
+                var_fields.append((n, t))
+                pos += 4
+        if not var_fields:
+            if pos != len(data):
+                raise ValueError("container has trailing bytes")
+            return cls(**kwargs)
+        # first offset must point exactly at the end of the fixed part
+        if offsets[0] != pos:
+            raise ValueError("bad first container offset")
+        offsets.append(len(data))
+        for i, (n, t) in enumerate(var_fields):
+            if offsets[i] > offsets[i + 1]:
+                raise ValueError("container offsets not monotonic")
+            kwargs[n] = t.deserialize(data[offsets[i] : offsets[i + 1]])
+        return cls(**kwargs)
+
+    def hash_tree_root(cls, value) -> bytes:
+        roots = [t.hash_tree_root(getattr(value, n)) for n, t in cls._fields_.items()]
+        return merkleize_chunks(roots)
+
+
+class Container(metaclass=ContainerMeta):
+    """Value base class; subclass with annotated fields (SszType instances).
+
+    The subclass is simultaneously the value class and the type descriptor
+    (classmethod serialize/deserialize/hash_tree_root/default)."""
+
+    _fields_: Dict[str, SszType] = {}
+
+    def __init__(self, **kwargs):
+        for n, t in type(self)._fields_.items():
+            if n in kwargs:
+                object.__setattr__(self, n, kwargs.pop(n))
+            else:
+                object.__setattr__(self, n, t.default())
+        if kwargs:
+            raise TypeError(f"unknown fields: {sorted(kwargs)}")
+
+    def __setattr__(self, name, value):
+        if name not in type(self)._fields_:
+            raise AttributeError(f"{type(self).__name__} has no SSZ field {name!r}")
+        object.__setattr__(self, name, value)
+
+    def copy(self):
+        """Shallow-ish copy: nested containers/lists copied one level deep."""
+        kwargs = {}
+        for n in type(self)._fields_:
+            v = getattr(self, n)
+            if isinstance(v, Container):
+                v = v.copy()
+            elif isinstance(v, list):
+                v = list(v)
+            kwargs[n] = v
+        return type(self)(**kwargs)
+
+    def __eq__(self, other):
+        if type(self) is not type(other):
+            return NotImplemented
+        return all(
+            getattr(self, n) == getattr(other, n) for n in type(self)._fields_
+        )
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={getattr(self, n)!r}" for n in type(self)._fields_)
+        return f"{type(self).__name__}({inner})"
+
+
+# convenient aliases ---------------------------------------------------------
+
+uint8 = Uint(8)
+uint16 = Uint(16)
+uint32 = Uint(32)
+uint64 = Uint(64)
+uint128 = Uint(128)
+uint256 = Uint(256)
+boolean = Boolean()
+
+
+class _Indexable:
+    """Vector[elem, N] / List[elem, N] / ... sugar."""
+
+    def __init__(self, ctor, name):
+        self.ctor = ctor
+        self.name = name
+
+    def __getitem__(self, args):
+        if not isinstance(args, tuple):
+            args = (args,)
+        return self.ctor(*args)
+
+    def __repr__(self):
+        return self.name
+
+
+def _vec(elem, n):
+    return VectorT(elem, n)
+
+
+def _lst(elem, n):
+    return ListT(elem, n)
+
+
+Vector = _Indexable(_vec, "Vector")
+List = _Indexable(_lst, "List")
+Bitvector = _Indexable(BitvectorT, "Bitvector")
+Bitlist = _Indexable(BitlistT, "Bitlist")
+ByteVector = _Indexable(ByteVectorT, "ByteVector")
+ByteList = _Indexable(ByteListT, "ByteList")
+
+Bytes4 = ByteVectorT(4)
+Bytes20 = ByteVectorT(20)
+Bytes32 = ByteVectorT(32)
+Bytes48 = ByteVectorT(48)
+Bytes96 = ByteVectorT(96)

@@ -1,0 +1,87 @@
+"""Deposit processing (reference:
+packages/state-transition/src/block/processDeposit.ts).
+"""
+from __future__ import annotations
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    DOMAIN_DEPOSIT,
+    FAR_FUTURE_EPOCH,
+    FORK_SEQ,
+    ForkName,
+)
+from lodestar_tpu.types import ssz
+from ..util.domain import ZERO_HASH, compute_domain, compute_signing_root
+from ..util.merkle import is_valid_merkle_branch
+
+
+def process_deposit(fork: ForkName, cfg, state, deposit, pubkey2index=None) -> None:
+    """Apply one Deposit: verify merkle proof, then either top up an
+    existing validator or add a new one after checking its proof of
+    possession.  `pubkey2index` is the chain's flat pubkey cache (the
+    reference's epochCtx.pubkey2index); falls back to a linear scan."""
+    data = deposit.data
+    if not is_valid_merkle_branch(
+        ssz.phase0.DepositData.hash_tree_root(data),
+        deposit.proof,
+        DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+        state.eth1_deposit_index,
+        state.eth1_data.deposit_root,
+    ):
+        raise ValueError("Deposit has invalid merkle proof")
+
+    state.eth1_deposit_index += 1
+
+    pubkey = bytes(data.pubkey)
+    if pubkey2index is not None:
+        index = pubkey2index.get(pubkey)
+    else:
+        index = next(
+            (i for i, v in enumerate(state.validators) if bytes(v.pubkey) == pubkey),
+            None,
+        )
+
+    if index is None or index >= len(state.validators):
+        # new validator: verify the proof of possession (deposit signature)
+        dm = ssz.phase0.DepositMessage(
+            pubkey=data.pubkey,
+            withdrawal_credentials=data.withdrawal_credentials,
+            amount=data.amount,
+        )
+        domain = compute_domain(DOMAIN_DEPOSIT, cfg.GENESIS_FORK_VERSION, ZERO_HASH)
+        signing_root = compute_signing_root(ssz.phase0.DepositMessage, dm, domain)
+        try:
+            pk = bls.PublicKey.from_bytes(pubkey)
+            sig = bls.Signature.from_bytes(bytes(data.signature))
+            if not bls.verify(pk, signing_root, sig):
+                return
+        except bls.BlsError:
+            return
+
+        eff = min(
+            data.amount - data.amount % _p.EFFECTIVE_BALANCE_INCREMENT,
+            _p.MAX_EFFECTIVE_BALANCE,
+        )
+        state.validators.append(
+            ssz.phase0.Validator(
+                pubkey=data.pubkey,
+                withdrawal_credentials=data.withdrawal_credentials,
+                effective_balance=eff,
+                slashed=False,
+                activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+                activation_epoch=FAR_FUTURE_EPOCH,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+        )
+        state.balances.append(data.amount)
+        if pubkey2index is not None:
+            pubkey2index[pubkey] = len(state.validators) - 1
+        if FORK_SEQ[fork] >= FORK_SEQ[ForkName.altair]:
+            state.inactivity_scores.append(0)
+            state.previous_epoch_participation.append(0)
+            state.current_epoch_participation.append(0)
+    else:
+        state.balances[index] += data.amount

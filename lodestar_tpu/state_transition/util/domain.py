@@ -1,0 +1,32 @@
+"""Domain & signing-root helpers (reference:
+packages/state-transition/src/util/domain.ts and signingRoot.ts).
+"""
+from __future__ import annotations
+
+from lodestar_tpu.types import ssz
+
+ZERO_HASH = b"\x00" * 32
+
+
+def compute_fork_data_root(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    fd = ssz.phase0.ForkData(
+        current_version=current_version,
+        genesis_validators_root=genesis_validators_root,
+    )
+    return ssz.phase0.ForkData.hash_tree_root(fd)
+
+
+def compute_domain(
+    domain_type: bytes,
+    fork_version: bytes,
+    genesis_validators_root: bytes = ZERO_HASH,
+) -> bytes:
+    fork_data_root = compute_fork_data_root(fork_version, genesis_validators_root)
+    return domain_type + fork_data_root[:28]
+
+
+def compute_signing_root(ssz_type, obj, domain: bytes) -> bytes:
+    sd = ssz.phase0.SigningData(
+        object_root=ssz_type.hash_tree_root(obj), domain=domain
+    )
+    return ssz.phase0.SigningData.hash_tree_root(sd)

@@ -1,0 +1,166 @@
+"""Genesis state construction (reference:
+packages/state-transition/src/util/genesis.ts and the interop dev-state
+builders, beacon-node/src/node/utils/{state.ts,interop/}).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Tuple
+
+from lodestar_tpu.config import ChainConfig
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    BLS_WITHDRAWAL_PREFIX,
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    DOMAIN_DEPOSIT,
+    GENESIS_EPOCH,
+    GENESIS_SLOT,
+    ForkName,
+)
+from lodestar_tpu.types import ssz
+from ..block.process_deposit import process_deposit
+from .domain import ZERO_HASH, compute_domain, compute_signing_root
+from .interop import interop_secret_keys
+from .merkle import list_single_proof, list_tree_root
+from .misc import compute_epoch_at_slot, get_active_validator_indices
+
+
+def get_temporary_block_header() -> "ssz.phase0.BeaconBlockHeader":
+    """Header of the default genesis block (body_root of an empty body)."""
+    body = ssz.phase0.BeaconBlockBody.default()
+    return ssz.phase0.BeaconBlockHeader(
+        slot=GENESIS_SLOT,
+        proposer_index=0,
+        parent_root=ZERO_HASH,
+        state_root=ZERO_HASH,
+        body_root=ssz.phase0.BeaconBlockBody.hash_tree_root(body),
+    )
+
+
+def get_genesis_beacon_state(cfg: ChainConfig) -> "ssz.phase0.BeaconState":
+    state = ssz.phase0.BeaconState.default()
+    state.slot = GENESIS_SLOT
+    state.fork = ssz.phase0.Fork(
+        previous_version=cfg.GENESIS_FORK_VERSION,
+        current_version=cfg.GENESIS_FORK_VERSION,
+        epoch=GENESIS_EPOCH,
+    )
+    state.latest_block_header = get_temporary_block_header()
+    return state
+
+
+def apply_deposits(
+    cfg: ChainConfig, state, deposits, deposit_data_roots: Optional[List[bytes]] = None
+) -> int:
+    """Genesis deposit application: incrementally advance
+    eth1_data.deposit_root then process each deposit; finish with balance/
+    activation sweep and genesis_validators_root (genesis.ts applyDeposits)."""
+    roots = deposit_data_roots or [
+        ssz.phase0.DepositData.hash_tree_root(d.data) for d in deposits
+    ]
+    pubkey2index = {
+        bytes(v.pubkey): i for i, v in enumerate(state.validators)
+    }
+    for i, deposit in enumerate(deposits):
+        state.eth1_data.deposit_root = list_tree_root(
+            roots[: i + 1], DEPOSIT_CONTRACT_TREE_DEPTH, i + 1
+        )
+        state.eth1_data.deposit_count += 1
+        process_deposit(ForkName.phase0, cfg, state, deposit, pubkey2index)
+
+    activated = 0
+    for i, v in enumerate(state.validators):
+        if v.activation_epoch == GENESIS_EPOCH:
+            continue
+        balance = state.balances[i]
+        eff = min(
+            balance - balance % _p.EFFECTIVE_BALANCE_INCREMENT,
+            _p.MAX_EFFECTIVE_BALANCE,
+        )
+        v.effective_balance = eff
+        if eff == _p.MAX_EFFECTIVE_BALANCE:
+            v.activation_eligibility_epoch = GENESIS_EPOCH
+            v.activation_epoch = GENESIS_EPOCH
+            activated += 1
+
+    validators_t = ssz.phase0.BeaconState._fields_["validators"]
+    state.genesis_validators_root = validators_t.hash_tree_root(state.validators)
+    return activated
+
+
+def initialize_beacon_state_from_eth1(
+    cfg: ChainConfig,
+    eth1_block_hash: bytes,
+    eth1_timestamp: int,
+    deposits,
+    deposit_data_roots: Optional[List[bytes]] = None,
+):
+    state = get_genesis_beacon_state(cfg)
+    state.genesis_time = eth1_timestamp + cfg.GENESIS_DELAY
+    state.eth1_data.block_hash = eth1_block_hash
+    state.randao_mixes = [eth1_block_hash] * _p.EPOCHS_PER_HISTORICAL_VECTOR
+    apply_deposits(cfg, state, deposits, deposit_data_roots)
+    return state
+
+
+def is_valid_genesis_state(cfg: ChainConfig, state) -> bool:
+    if state.genesis_time < cfg.MIN_GENESIS_TIME:
+        return False
+    active = get_active_validator_indices(state, compute_epoch_at_slot(GENESIS_SLOT))
+    return len(active) >= cfg.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+
+
+# ---------------------------------------------------------------------------
+# interop / dev chain builders (beacon-node/src/node/utils/interop/)
+# ---------------------------------------------------------------------------
+
+
+def interop_deposits(
+    cfg: ChainConfig, count: int, with_eth1_credentials: bool = False
+) -> List["ssz.phase0.Deposit"]:
+    """Deterministic dev deposits; proof generated from the incremental
+    deposit tree exactly like interop/deposits.ts (tree contains leaves
+    0..i when proving leaf i)."""
+    sks = interop_secret_keys(count)
+    roots: List[bytes] = []
+    deposits = []
+    prefix = 1 if with_eth1_credentials else BLS_WITHDRAWAL_PREFIX
+    for i, sk in enumerate(sks):
+        pubkey = sk.to_public_key().to_bytes()
+        wc = bytearray(hashlib.sha256(pubkey).digest())
+        wc[0] = prefix
+        data = ssz.phase0.DepositData(
+            pubkey=pubkey,
+            withdrawal_credentials=bytes(wc),
+            amount=_p.MAX_EFFECTIVE_BALANCE,
+            signature=b"\x00" * 96,
+        )
+        dm = ssz.phase0.DepositMessage(
+            pubkey=pubkey, withdrawal_credentials=bytes(wc), amount=data.amount
+        )
+        domain = compute_domain(DOMAIN_DEPOSIT, cfg.GENESIS_FORK_VERSION, ZERO_HASH)
+        data.signature = sk.sign(
+            compute_signing_root(ssz.phase0.DepositMessage, dm, domain)
+        ).to_bytes()
+        roots.append(ssz.phase0.DepositData.hash_tree_root(data))
+        proof = list_single_proof(roots, DEPOSIT_CONTRACT_TREE_DEPTH, i, i + 1)
+        deposits.append(ssz.phase0.Deposit(proof=proof, data=data))
+    return deposits
+
+
+def init_dev_state(
+    cfg: ChainConfig,
+    validator_count: int,
+    genesis_time: Optional[int] = None,
+    eth1_block_hash: bytes = b"B" * 32,
+    eth1_timestamp: int = 2**40,
+) -> Tuple[List["ssz.phase0.Deposit"], "ssz.phase0.BeaconState"]:
+    """initDevState (beacon-node/src/node/utils/state.ts): interop deposits
+    + genesis state with overridable genesis time."""
+    deposits = interop_deposits(cfg, validator_count)
+    state = initialize_beacon_state_from_eth1(
+        cfg, eth1_block_hash, eth1_timestamp, deposits
+    )
+    if genesis_time is not None:
+        state.genesis_time = genesis_time
+    return deposits, state

@@ -1,0 +1,22 @@
+"""Interop (devnet) deterministic keys (reference:
+packages/state-transition/src/util/interop.ts; eth2.0-pm interop spec).
+
+sk_i = int_LE(sha256(uint256_LE(i))) mod r
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List
+
+from lodestar_tpu.crypto.bls.api import SecretKey
+from lodestar_tpu.crypto.bls.fields import R as CURVE_ORDER
+
+
+def interop_secret_key(index: int) -> SecretKey:
+    h = hashlib.sha256(index.to_bytes(32, "little")).digest()
+    sk = int.from_bytes(h, "little") % CURVE_ORDER
+    return SecretKey(sk)
+
+
+def interop_secret_keys(count: int) -> List[SecretKey]:
+    return [interop_secret_key(i) for i in range(count)]

@@ -1,0 +1,61 @@
+"""Merkle branch verification + single-proof generation for SSZ List trees.
+
+Reference: @lodestar/utils verifyMerkleBranch and
+@chainsafe/persistent-merkle-tree getSingleProof (used by the deposit tree,
+beacon-node/src/node/utils/interop/deposits.ts).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from lodestar_tpu.ssz.core import ZERO_HASHES, hash_nodes
+
+
+def is_valid_merkle_branch(
+    leaf: bytes, branch: Sequence[bytes], depth: int, index: int, root: bytes
+) -> bool:
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = hash_nodes(branch[i], value)
+        else:
+            value = hash_nodes(value, branch[i])
+    return value == root
+
+
+def list_tree_layers(leaves: Sequence[bytes], depth: int) -> List[List[bytes]]:
+    """Bottom-up layers of a depth-`depth` padded tree over `leaves`."""
+    layers = [[bytes(leaf) for leaf in leaves]]
+    for level in range(depth):
+        prev = layers[-1]
+        nxt = []
+        for i in range(0, len(prev) - 1, 2):
+            nxt.append(hash_nodes(prev[i], prev[i + 1]))
+        if len(prev) % 2:
+            nxt.append(hash_nodes(prev[-1], ZERO_HASHES[level]))
+        layers.append(nxt)
+    return layers
+
+
+def list_single_proof(
+    leaves: Sequence[bytes], depth: int, index: int, length: int
+) -> List[bytes]:
+    """Proof for leaf `index` of an SSZ List[Root, 2**depth] tree: `depth`
+    sibling hashes bottom-up plus the mix-in-length chunk (the shape of the
+    reference's deposit proof fixture)."""
+    layers = list_tree_layers(leaves, depth)
+    proof = []
+    idx = index
+    for level in range(depth):
+        sib = idx ^ 1
+        layer = layers[level]
+        proof.append(layer[sib] if sib < len(layer) else ZERO_HASHES[level])
+        idx >>= 1
+    proof.append(int(length).to_bytes(32, "little"))
+    return proof
+
+
+def list_tree_root(leaves: Sequence[bytes], depth: int, length: int) -> bytes:
+    layers = list_tree_layers(leaves, depth)
+    top = layers[depth][0] if layers[depth] else ZERO_HASHES[depth]
+    return hash_nodes(top, int(length).to_bytes(32, "little"))

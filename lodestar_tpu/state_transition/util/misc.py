@@ -1,0 +1,174 @@
+"""Core spec helpers: epochs, balances, randao, seeds, shuffling, committees.
+
+Mirrors packages/state-transition/src/util/{epoch,validator,seed,shuffle,
+balance,blockRoot}.ts.  The full-list shuffling is vectorized with numpy —
+the flat-array representation the reference computes once per epoch in its
+EpochContext (cache/epochShuffling.ts) and exactly the layout a TPU kernel
+wants.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DOMAIN_BEACON_PROPOSER,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+)
+
+SLOTS_PER_EPOCH = _p.SLOTS_PER_EPOCH
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def int_to_bytes(n: int, length: int) -> bytes:
+    return int(n).to_bytes(length, "little")
+
+
+def compute_epoch_at_slot(slot: int) -> int:
+    return slot // SLOTS_PER_EPOCH
+
+
+def compute_start_slot_at_epoch(epoch: int) -> int:
+    return epoch * SLOTS_PER_EPOCH
+
+
+def compute_activation_exit_epoch(epoch: int) -> int:
+    return epoch + 1 + _p.MAX_SEED_LOOKAHEAD
+
+
+def is_active_validator(validator, epoch: int) -> bool:
+    return validator.activation_epoch <= epoch < validator.exit_epoch
+
+
+def get_active_validator_indices(state, epoch: int) -> List[int]:
+    return [
+        i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
+    ]
+
+
+def get_validator_churn_limit(cfg, active_count: int) -> int:
+    return max(cfg.MIN_PER_EPOCH_CHURN_LIMIT, active_count // cfg.CHURN_LIMIT_QUOTIENT)
+
+
+def increase_balance(state, index: int, delta: int) -> None:
+    state.balances[index] += delta
+
+
+def decrease_balance(state, index: int, delta: int) -> None:
+    state.balances[index] = max(0, state.balances[index] - delta)
+
+
+def get_randao_mix(state, epoch: int) -> bytes:
+    return state.randao_mixes[epoch % _p.EPOCHS_PER_HISTORICAL_VECTOR]
+
+
+def get_block_root_at_slot(state, slot: int) -> bytes:
+    if not (slot < state.slot <= slot + _p.SLOTS_PER_HISTORICAL_ROOT):
+        raise ValueError(f"slot {slot} out of block_roots range at {state.slot}")
+    return state.block_roots[slot % _p.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def get_block_root(state, epoch: int) -> bytes:
+    return get_block_root_at_slot(state, compute_start_slot_at_epoch(epoch))
+
+
+def get_seed(state, epoch: int, domain_type: bytes) -> bytes:
+    mix = get_randao_mix(
+        state, epoch + _p.EPOCHS_PER_HISTORICAL_VECTOR - _p.MIN_SEED_LOOKAHEAD - 1
+    )
+    return sha256(domain_type + int_to_bytes(epoch, 8) + mix)
+
+
+# ---------------------------------------------------------------------------
+# swap-or-not shuffling (spec compute_shuffled_index + vectorized full list)
+# ---------------------------------------------------------------------------
+
+
+def compute_shuffled_index(index: int, count: int, seed: bytes) -> int:
+    """Scalar spec shuffling of one index (forward permutation)."""
+    assert index < count
+    for round_ in range(_p.SHUFFLE_ROUND_COUNT):
+        pivot = (
+            int.from_bytes(sha256(seed + bytes([round_]))[:8], "little") % count
+        )
+        flip = (pivot + count - index) % count
+        position = max(index, flip)
+        source = sha256(seed + bytes([round_]) + int_to_bytes(position // 256, 4))
+        byte = source[(position % 256) // 8]
+        bit = (byte >> (position % 8)) & 1
+        index = flip if bit else index
+    return index
+
+
+def compute_shuffled_indices_vec(count: int, seed: bytes) -> np.ndarray:
+    """compute_shuffled_index applied to every position at once (numpy).
+
+    Each swap-or-not round is an elementwise involution, so running the
+    scalar update rule over the whole positions array yields the forward
+    map f for all positions simultaneously.  This is the flat epoch-cache
+    layout the reference computes in cache/epochShuffling.ts, vectorized.
+    """
+    positions = np.arange(count, dtype=np.int64)
+    if count == 0:
+        return positions
+    nblocks = (count + 255) // 256
+    for round_ in range(_p.SHUFFLE_ROUND_COUNT):
+        pivot = (
+            int.from_bytes(sha256(seed + bytes([round_]))[:8], "little") % count
+        )
+        flip = (pivot - positions) % count
+        pos_max = np.maximum(positions, flip)
+        srcs = np.frombuffer(
+            b"".join(
+                sha256(seed + bytes([round_]) + int_to_bytes(b, 4))
+                for b in range(nblocks)
+            ),
+            dtype=np.uint8,
+        )
+        byte = srcs[pos_max // 8]
+        bit = (byte >> (pos_max % 8).astype(np.uint8)) & 1
+        positions = np.where(bit == 1, flip, positions)
+    return positions
+
+
+def shuffle_list(indices: np.ndarray, seed: bytes) -> np.ndarray:
+    """Full shuffled list L with L[pos] = indices[f(pos)] — the committee
+    layout consumed by compute_committee."""
+    return np.asarray(indices)[compute_shuffled_indices_vec(len(indices), seed)]
+
+
+def compute_proposer_index(
+    effective_balances: Sequence[int], indices: Sequence[int], seed: bytes
+) -> int:
+    """Spec compute_proposer_index over active `indices` with a flat
+    effective-balance array (reference epochContext computeProposers)."""
+    assert len(indices) > 0
+    MAX_RANDOM_BYTE = 255
+    n = len(indices)
+    i = 0
+    while True:
+        candidate = indices[compute_shuffled_index(i % n, n, seed)]
+        random_byte = sha256(seed + int_to_bytes(i // 32, 8))[i % 32]
+        if (
+            effective_balances[candidate] * MAX_RANDOM_BYTE
+            >= _p.MAX_EFFECTIVE_BALANCE * random_byte
+        ):
+            return candidate
+        i += 1
+
+
+def compute_committee_count_per_slot(active_count: int) -> int:
+    return max(
+        1,
+        min(
+            _p.MAX_COMMITTEES_PER_SLOT,
+            active_count // SLOTS_PER_EPOCH // _p.TARGET_COMMITTEE_SIZE,
+        ),
+    )

@@ -1,0 +1,21 @@
+"""SSZ types per fork — the rebuild's `@lodestar/types`.
+
+`ssz.phase0` / `ssz.altair` namespaces mirror packages/types/src/sszTypes.ts.
+"""
+from . import altair, phase0
+
+
+class _Namespace:
+    def __init__(self, mod):
+        self._mod = mod
+
+    def __getattr__(self, name):
+        return getattr(self._mod, name)
+
+
+class _Ssz:
+    phase0 = _Namespace(phase0)
+    altair = _Namespace(altair)
+
+
+ssz = _Ssz()

@@ -1,0 +1,153 @@
+"""Altair SSZ container types (reference: packages/types/src/altair/sszTypes.ts)."""
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    FINALIZED_ROOT_DEPTH,
+    JUSTIFICATION_BITS_LENGTH,
+    NEXT_SYNC_COMMITTEE_DEPTH,
+    SYNC_COMMITTEE_SUBNET_COUNT,
+)
+from lodestar_tpu.ssz.core import (
+    Bitlist,
+    Bitvector,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    Vector,
+    uint8,
+    uint64,
+)
+from . import phase0
+
+SYNC_SUBCOMMITTEE_SIZE = _p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+
+# per-validator participation flag bytes (uint8), the altair replacement for
+# phase0's PendingAttestation lists
+EpochParticipation = List[uint8, _p.VALIDATOR_REGISTRY_LIMIT]
+
+
+class SyncCommittee(Container):
+    pubkeys: Vector[Bytes48, _p.SYNC_COMMITTEE_SIZE]
+    aggregate_pubkey: Bytes48
+
+
+class SyncAggregate(Container):
+    sync_committee_bits: Bitvector[_p.SYNC_COMMITTEE_SIZE]
+    sync_committee_signature: Bytes96
+
+
+class SyncCommitteeMessage(Container):
+    slot: phase0.Slot
+    beacon_block_root: phase0.Root
+    validator_index: phase0.ValidatorIndex
+    signature: phase0.BLSSignature
+
+
+class SyncCommitteeContribution(Container):
+    slot: phase0.Slot
+    beacon_block_root: phase0.Root
+    subcommittee_index: uint64
+    aggregation_bits: Bitvector[SYNC_SUBCOMMITTEE_SIZE]
+    signature: phase0.BLSSignature
+
+
+class ContributionAndProof(Container):
+    aggregator_index: phase0.ValidatorIndex
+    contribution: SyncCommitteeContribution
+    selection_proof: phase0.BLSSignature
+
+
+class SignedContributionAndProof(Container):
+    message: ContributionAndProof
+    signature: phase0.BLSSignature
+
+
+class SyncAggregatorSelectionData(Container):
+    slot: phase0.Slot
+    subcommittee_index: uint64
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: phase0.BLSSignature
+    eth1_data: phase0.Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[phase0.ProposerSlashing, _p.MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[phase0.AttesterSlashing, _p.MAX_ATTESTER_SLASHINGS]
+    attestations: List[phase0.Attestation, _p.MAX_ATTESTATIONS]
+    deposits: List[phase0.Deposit, _p.MAX_DEPOSITS]
+    voluntary_exits: List[phase0.SignedVoluntaryExit, _p.MAX_VOLUNTARY_EXITS]
+    sync_aggregate: SyncAggregate
+
+
+class BeaconBlock(Container):
+    slot: phase0.Slot
+    proposer_index: phase0.ValidatorIndex
+    parent_root: phase0.Root
+    state_root: phase0.Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: phase0.BLSSignature
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: phase0.Root
+    slot: phase0.Slot
+    fork: phase0.Fork
+    latest_block_header: phase0.BeaconBlockHeader
+    block_roots: Vector[phase0.Root, _p.SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[phase0.Root, _p.SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[phase0.Root, _p.HISTORICAL_ROOTS_LIMIT]
+    eth1_data: phase0.Eth1Data
+    eth1_data_votes: phase0.Eth1DataVotes
+    eth1_deposit_index: uint64
+    validators: List[phase0.Validator, _p.VALIDATOR_REGISTRY_LIMIT]
+    balances: List[phase0.Gwei, _p.VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, _p.EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[phase0.Gwei, _p.EPOCHS_PER_SLASHINGS_VECTOR]
+    previous_epoch_participation: EpochParticipation
+    current_epoch_participation: EpochParticipation
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: phase0.Checkpoint
+    current_justified_checkpoint: phase0.Checkpoint
+    finalized_checkpoint: phase0.Checkpoint
+    inactivity_scores: List[uint64, _p.VALIDATOR_REGISTRY_LIMIT]
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+
+
+# light client ---------------------------------------------------------------
+
+
+class LightClientBootstrap(Container):
+    header: phase0.BeaconBlockHeader
+    current_sync_committee: SyncCommittee
+    current_sync_committee_branch: Vector[Bytes32, NEXT_SYNC_COMMITTEE_DEPTH]
+
+
+class LightClientUpdate(Container):
+    attested_header: phase0.BeaconBlockHeader
+    next_sync_committee: SyncCommittee
+    next_sync_committee_branch: Vector[Bytes32, NEXT_SYNC_COMMITTEE_DEPTH]
+    finalized_header: phase0.BeaconBlockHeader
+    finality_branch: Vector[Bytes32, FINALIZED_ROOT_DEPTH]
+    sync_aggregate: SyncAggregate
+    signature_slot: phase0.Slot
+
+
+class LightClientFinalityUpdate(Container):
+    attested_header: phase0.BeaconBlockHeader
+    finalized_header: phase0.BeaconBlockHeader
+    finality_branch: Vector[Bytes32, FINALIZED_ROOT_DEPTH]
+    sync_aggregate: SyncAggregate
+    signature_slot: phase0.Slot
+
+
+class LightClientOptimisticUpdate(Container):
+    attested_header: phase0.BeaconBlockHeader
+    sync_aggregate: SyncAggregate
+    signature_slot: phase0.Slot

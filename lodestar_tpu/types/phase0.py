@@ -1,0 +1,269 @@
+"""Phase0 SSZ container types (reference: packages/types/src/phase0/sszTypes.ts).
+
+Field order is consensus-critical: it must match the consensus-specs phase0
+definitions exactly (validated by the interop genesis-state root KAT in
+tests/test_state_kats.py).  Vector lengths come from the active preset, like
+the reference's compile-time preset switch.
+"""
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    JUSTIFICATION_BITS_LENGTH,
+)
+from lodestar_tpu.ssz.core import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Bytes4,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    Vector,
+    boolean,
+    uint64,
+)
+
+# aliases mirroring primitiveSsz
+Slot = uint64
+Epoch = uint64
+CommitteeIndex = uint64
+ValidatorIndex = uint64
+Gwei = uint64
+Root = Bytes32
+Version = Bytes4
+DomainType = Bytes4
+ForkDigest = Bytes4
+BLSPubkey = Bytes48
+BLSSignature = Bytes96
+Domain = Bytes32
+
+EpochList = List[uint64, _p.VALIDATOR_REGISTRY_LIMIT]
+CommitteeIndices = List[ValidatorIndex, _p.MAX_VALIDATORS_PER_COMMITTEE]
+CommitteeBits = Bitlist[_p.MAX_VALIDATORS_PER_COMMITTEE]
+
+
+class Fork(Container):
+    previous_version: Version
+    current_version: Version
+    epoch: Epoch
+
+
+class ForkData(Container):
+    current_version: Version
+    genesis_validators_root: Root
+
+
+class Checkpoint(Container):
+    epoch: Epoch
+    root: Root
+
+
+class Validator(Container):
+    pubkey: BLSPubkey
+    withdrawal_credentials: Bytes32
+    effective_balance: Gwei
+    slashed: boolean
+    activation_eligibility_epoch: Epoch
+    activation_epoch: Epoch
+    exit_epoch: Epoch
+    withdrawable_epoch: Epoch
+
+
+class AttestationData(Container):
+    slot: Slot
+    index: CommitteeIndex
+    beacon_block_root: Root
+    source: Checkpoint
+    target: Checkpoint
+
+
+class IndexedAttestation(Container):
+    attesting_indices: CommitteeIndices
+    data: AttestationData
+    signature: BLSSignature
+
+
+class PendingAttestation(Container):
+    aggregation_bits: CommitteeBits
+    data: AttestationData
+    inclusion_delay: Slot
+    proposer_index: ValidatorIndex
+
+
+class Eth1Data(Container):
+    deposit_root: Root
+    deposit_count: uint64
+    block_hash: Bytes32
+
+
+Eth1DataVotes = List[
+    Eth1Data, _p.EPOCHS_PER_ETH1_VOTING_PERIOD * _p.SLOTS_PER_EPOCH
+]
+
+
+class HistoricalBatch(Container):
+    block_roots: Vector[Root, _p.SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, _p.SLOTS_PER_HISTORICAL_ROOT]
+
+
+class DepositMessage(Container):
+    pubkey: BLSPubkey
+    withdrawal_credentials: Bytes32
+    amount: Gwei
+
+
+class DepositData(Container):
+    pubkey: BLSPubkey
+    withdrawal_credentials: Bytes32
+    amount: Gwei
+    signature: BLSSignature
+
+
+# DepositDataRootList: the deposit contract's incremental merkle list
+DepositDataRootList = List[Root, 2**DEPOSIT_CONTRACT_TREE_DEPTH]
+
+
+class DepositEvent(Container):
+    deposit_data: DepositData
+    block_number: uint64
+    index: uint64
+
+
+class BeaconBlockHeader(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body_root: Root
+
+
+class SignedBeaconBlockHeader(Container):
+    message: BeaconBlockHeader
+    signature: BLSSignature
+
+
+class SigningData(Container):
+    object_root: Root
+    domain: Domain
+
+
+class Attestation(Container):
+    aggregation_bits: CommitteeBits
+    data: AttestationData
+    signature: BLSSignature
+
+
+class AggregateAndProof(Container):
+    aggregator_index: ValidatorIndex
+    aggregate: Attestation
+    selection_proof: BLSSignature
+
+
+class SignedAggregateAndProof(Container):
+    message: AggregateAndProof
+    signature: BLSSignature
+
+
+class AttesterSlashing(Container):
+    attestation_1: IndexedAttestation
+    attestation_2: IndexedAttestation
+
+
+class ProposerSlashing(Container):
+    signed_header_1: SignedBeaconBlockHeader
+    signed_header_2: SignedBeaconBlockHeader
+
+
+class Deposit(Container):
+    proof: Vector[Bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1]
+    data: DepositData
+
+
+class VoluntaryExit(Container):
+    epoch: Epoch
+    validator_index: ValidatorIndex
+
+
+class SignedVoluntaryExit(Container):
+    message: VoluntaryExit
+    signature: BLSSignature
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: BLSSignature
+    eth1_data: Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[ProposerSlashing, _p.MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[AttesterSlashing, _p.MAX_ATTESTER_SLASHINGS]
+    attestations: List[Attestation, _p.MAX_ATTESTATIONS]
+    deposits: List[Deposit, _p.MAX_DEPOSITS]
+    voluntary_exits: List[SignedVoluntaryExit, _p.MAX_VOLUNTARY_EXITS]
+
+
+class BeaconBlock(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: BLSSignature
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: Root
+    slot: Slot
+    fork: Fork
+    latest_block_header: BeaconBlockHeader
+    block_roots: Vector[Root, _p.SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, _p.SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[Root, _p.HISTORICAL_ROOTS_LIMIT]
+    eth1_data: Eth1Data
+    eth1_data_votes: Eth1DataVotes
+    eth1_deposit_index: uint64
+    validators: List[Validator, _p.VALIDATOR_REGISTRY_LIMIT]
+    balances: List[Gwei, _p.VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, _p.EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[Gwei, _p.EPOCHS_PER_SLASHINGS_VECTOR]
+    previous_epoch_attestations: List[
+        PendingAttestation, _p.MAX_ATTESTATIONS * _p.SLOTS_PER_EPOCH
+    ]
+    current_epoch_attestations: List[
+        PendingAttestation, _p.MAX_ATTESTATIONS * _p.SLOTS_PER_EPOCH
+    ]
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: Checkpoint
+    current_justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+
+
+# p2p wire types -------------------------------------------------------------
+
+
+class Status(Container):
+    fork_digest: ForkDigest
+    finalized_root: Root
+    finalized_epoch: Epoch
+    head_root: Root
+    head_slot: Slot
+
+
+Goodbye = uint64
+Ping = uint64
+
+
+class Metadata(Container):
+    seq_number: uint64
+    attnets: Bitvector[64]
+
+
+class Eth1Block(Container):
+    timestamp: uint64
+    deposit_root: Root
+    deposit_count: uint64

@@ -1,14 +1,28 @@
-"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+"""Test harness config: virtual 8-device CPU mesh + minimal preset.
 
 Multi-chip TPU hardware isn't available in CI; sharding correctness is
 validated on a host-platform device mesh exactly as the driver's
 ``dryrun_multichip`` does.  Must run before any ``import jax``.
+
+Like the reference's test suite (beacon-node/test/setupPreset.ts forces
+LODESTAR_PRESET=minimal), consensus tests run on the minimal preset; the
+blst-produced interop fixtures embedded in tests/test_state_kats.py were
+generated under it.
+
+A persistent JAX compilation cache makes the (expensive, single-core) XLA
+CPU compiles of the pairing kernels a one-time cost across test runs.
 """
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/tpu default
+os.environ.setdefault("LODESTAR_TPU_PRESET", "minimal")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,206 @@
+"""Fork choice unit tests — onBlock/onAttestation/findHead semantics
+mirroring the reference's suites
+(packages/fork-choice/test/unit/{protoArray,forkChoice}/).
+"""
+import pytest
+
+from lodestar_tpu.config import minimal_chain_config as cfg
+from lodestar_tpu.fork_choice import (
+    CheckpointHex,
+    ExecutionStatus,
+    ForkChoice,
+    ForkChoiceStore,
+    ProtoArray,
+    ProtoBlock,
+    ZERO_ROOT_HEX,
+)
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+
+def root(n: int, prefix: int = 0xBB) -> str:
+    return "0x" + (bytes([prefix]) + n.to_bytes(31, "big")).hex()
+
+
+def block(
+    slot: int,
+    blk_root: str,
+    parent_root: str,
+    just_epoch: int = 0,
+    just_root: str = ZERO_ROOT_HEX,
+    fin_epoch: int = 0,
+    fin_root: str = ZERO_ROOT_HEX,
+) -> ProtoBlock:
+    return ProtoBlock(
+        slot=slot,
+        block_root=blk_root,
+        parent_root=parent_root,
+        state_root=blk_root,
+        target_root=blk_root,
+        justified_epoch=just_epoch,
+        justified_root=just_root,
+        finalized_epoch=fin_epoch,
+        finalized_root=fin_root,
+        unrealized_justified_epoch=just_epoch,
+        unrealized_justified_root=just_root,
+        unrealized_finalized_epoch=fin_epoch,
+        unrealized_finalized_root=fin_root,
+        execution_status=ExecutionStatus.PreMerge,
+    )
+
+
+GENESIS = root(0)
+
+
+def make_fc(n_validators=4, balance=32):
+    arr = ProtoArray.initialize(block(0, GENESIS, root(0xFF, 0xFF)), current_slot=1)
+    store = ForkChoiceStore(
+        current_slot=1,
+        justified=CheckpointHex(0, GENESIS),
+        justified_balances=[balance] * n_validators,
+        finalized=CheckpointHex(0, GENESIS),
+        unrealized_justified=CheckpointHex(0, GENESIS),
+        unrealized_finalized=CheckpointHex(0, GENESIS),
+    )
+    return ForkChoice(cfg, store, arr, proposer_boost_enabled=False)
+
+
+class TestProtoArray:
+    def test_single_chain_head_is_tip(self):
+        fc = make_fc()
+        fc.on_block(block(1, root(1), GENESIS), 99, fc.store.justified, fc.store.finalized)
+        fc.update_time(2)
+        fc.on_block(block(2, root(2), root(1)), 99, fc.store.justified, fc.store.finalized)
+        assert fc.update_head().block_root == root(2)
+
+    def test_votes_decide_fork(self):
+        fc = make_fc(n_validators=3)
+        # two children of genesis at slot 1
+        fc.on_block(block(1, root(1), GENESIS), 99, fc.store.justified, fc.store.finalized)
+        fc.on_block(block(1, root(2), GENESIS), 99, fc.store.justified, fc.store.finalized)
+        # 2 votes for root(1), 1 for root(2)
+        fc.on_attestation([0, 1], root(1), target_epoch=1)
+        fc.on_attestation([2], root(2), target_epoch=1)
+        assert fc.update_head().block_root == root(1)
+        # votes move: all three now vote root(2) with a newer epoch
+        fc.on_attestation([0, 1, 2], root(2), target_epoch=2)
+        assert fc.update_head().block_root == root(2)
+
+    def test_tie_break_by_lexicographic_root(self):
+        fc = make_fc()
+        a, b = root(1), root(2)
+        hi, lo = max(a, b), min(a, b)
+        fc.on_block(block(1, lo, GENESIS), 99, fc.store.justified, fc.store.finalized)
+        fc.on_block(block(1, hi, GENESIS), 99, fc.store.justified, fc.store.finalized)
+        assert fc.update_head().block_root == hi
+
+    def test_equivocating_validator_removed(self):
+        fc = make_fc(n_validators=2)
+        fc.on_block(block(1, root(1), GENESIS), 99, fc.store.justified, fc.store.finalized)
+        fc.on_block(block(1, root(2), GENESIS), 99, fc.store.justified, fc.store.finalized)
+        fc.on_attestation([0], root(1), 1)
+        fc.on_attestation([1], root(2), 1)
+        # validator 0 equivocates -> its weight is removed; head flips to 2
+        fc.on_attester_slashing([0], [0])
+        assert fc.update_head().block_root == root(2)
+
+    def test_unknown_parent_rejected(self):
+        fc = make_fc()
+        with pytest.raises(Exception):
+            fc.on_block(
+                block(1, root(5), root(77)), 99, fc.store.justified, fc.store.finalized
+            )
+
+    def test_is_descendant_and_ancestor(self):
+        fc = make_fc()
+        fc.on_block(block(1, root(1), GENESIS), 99, fc.store.justified, fc.store.finalized)
+        fc.update_time(2)
+        fc.on_block(block(2, root(2), root(1)), 99, fc.store.justified, fc.store.finalized)
+        assert fc.is_descendant(GENESIS, root(2))
+        assert fc.is_descendant(root(1), root(2))
+        assert not fc.is_descendant(root(2), root(1))
+        assert fc.get_ancestor(root(2), 1) == root(1)
+        assert fc.get_ancestor(root(2), 0) == GENESIS
+
+    def test_prune_keeps_post_finalized(self):
+        fc = make_fc()
+        prev = GENESIS
+        for s in range(1, 6):
+            fc.update_time(s)
+            fc.on_block(block(s, root(s), prev), 99, fc.store.justified, fc.store.finalized)
+            prev = root(s)
+        fc.proto_array.prune_threshold = 1
+        removed = fc.prune(root(3))
+        assert [n.block_root for n in removed] == [GENESIS, root(1), root(2)]
+        assert fc.proto_array.get_node(root(3)).parent is None
+        fc.store.justified = CheckpointHex(0, root(3))
+        # head still computable from the pruned array
+        assert fc.update_head().block_root == root(5)
+
+
+class TestViabilityFilter:
+    def test_wrong_justified_epoch_not_viable(self):
+        """A branch whose nodes disagree with the store's justified
+        checkpoint is filtered (filter_block_tree)."""
+        fc = make_fc()
+        e = _p.SLOTS_PER_EPOCH
+        # chain: genesis <- a (justified epoch 0) and b (justified epoch 1)
+        fc.store.current_slot = 2 * e
+        fc.proto_array.justified_epoch = 0
+        a = block(2 * e, root(0xA), GENESIS)
+        b = block(
+            2 * e, root(0xB), GENESIS, just_epoch=1, just_root=GENESIS
+        )
+        fc.on_block(a, 99, fc.store.justified, fc.store.finalized)
+        fc.on_block(b, 99, fc.store.justified, fc.store.finalized)
+        # store justifies epoch 1 -> only b's branch is viable
+        fc.store.justified = CheckpointHex(1, GENESIS)
+        fc.on_attestation([0, 1, 2, 3], root(0xA), 3)  # votes point at a...
+        head = fc.update_head()
+        assert head.block_root == root(0xB)  # ...but a is not viable
+
+
+class TestProposerBoost:
+    def test_timely_block_gets_boost(self):
+        fc = make_fc(n_validators=64)
+        fc.proposer_boost_enabled = True
+        # two competing slot-1 blocks; boosted one wins despite equal votes
+        fc.on_block(block(1, root(1), GENESIS), block_delay_sec=0.5,
+                    justified_checkpoint=fc.store.justified,
+                    finalized_checkpoint=fc.store.finalized)
+        assert fc.proposer_boost_root == root(1)
+        fc.on_block(block(1, root(2), GENESIS), block_delay_sec=9.9,
+                    justified_checkpoint=fc.store.justified,
+                    finalized_checkpoint=fc.store.finalized)
+        # tie-break would pick max root; boost overrides it toward root(1)
+        if root(1) < root(2):
+            assert fc.update_head().block_root == root(1)
+        # boost cleared on next slot
+        fc.update_time(2)
+        assert fc.proposer_boost_root is None
+
+
+class TestUnrealizedPullUp:
+    def test_current_epoch_unrealized_deferred_to_boundary(self):
+        """A current-epoch block's unrealized justification must NOT advance
+        the realized store until the next epoch tick (spec on_tick)."""
+        fc = make_fc()
+        e = _p.SLOTS_PER_EPOCH
+        fc.update_time(e + 1)
+        b = block(e + 1, root(0xC1), GENESIS)
+        b.unrealized_justified_epoch = 1
+        b.unrealized_justified_root = GENESIS
+        fc.on_block(b, 99, fc.store.justified, fc.store.finalized)
+        assert fc.store.justified.epoch == 0          # deferred
+        assert fc.store.unrealized_justified.epoch == 1
+        fc.update_time(2 * e)                          # epoch boundary
+        assert fc.store.justified.epoch == 1           # pulled up
+
+    def test_prior_epoch_unrealized_applied_immediately(self):
+        fc = make_fc()
+        e = _p.SLOTS_PER_EPOCH
+        fc.update_time(2 * e + 1)
+        b = block(e, root(0xC2), GENESIS)              # block from epoch 1
+        b.unrealized_justified_epoch = 1
+        b.unrealized_justified_root = GENESIS
+        fc.on_block(b, 99, fc.store.justified, fc.store.finalized)
+        assert fc.store.justified.epoch == 1           # immediate

@@ -1,0 +1,139 @@
+"""Known-answer tests against blst-produced fixtures and RFC vectors.
+
+Closes round-1 weakness #4 (no external vectors; self-validation only).
+Anchors, with provenance:
+
+* RFC 9380 K.1 expand_message_xmd(SHA-256) vectors (hex from the RFC).
+* Zero-subtree hashes 1..31 from the reference's interop deposit fixture
+  (/root/reference/packages/beacon-node/test/e2e/interop/genesisState.test.ts
+  deposit proof — produced by @chainsafe/persistent-merkle-tree).
+* Interop validator-0 pubkey + withdrawal credentials + deposit signature
+  from the same fixture — produced by the C blst library via @chainsafe/bls.
+  NOTE: the reference runs its test suite with LODESTAR_PRESET=minimal
+  (test/setupPreset.ts), so the deposit domain uses the minimal chain
+  config's GENESIS_FORK_VERSION=0x00000001.
+* ZCash-format compressed generators of G1/G2 (public constants).
+
+A sign-convention, DST, SSWU, isogeny, cofactor, or serialization bug
+anywhere in the oracle stack fails these bit-exactly.
+"""
+import hashlib
+
+from lodestar_tpu.crypto.bls import api, curve as oc
+from lodestar_tpu.crypto.bls.hash_to_curve import expand_message_xmd, hash_to_g2
+from lodestar_tpu.params import DOMAIN_DEPOSIT
+from lodestar_tpu.ssz.core import ZERO_HASHES
+from lodestar_tpu.state_transition.util.domain import (
+    compute_domain,
+    compute_signing_root,
+)
+from lodestar_tpu.state_transition.util.interop import interop_secret_key
+from lodestar_tpu.types import ssz
+
+INTEROP_PK0 = bytes.fromhex(
+    "a99a76ed7796f7be22d5b7e85deeb7c5677e88e511e0b337618f8c4eb61349b4"
+    "bf2d153f649f7b53359fe8b94a38e44c"
+)
+INTEROP_WC0 = bytes.fromhex(
+    "00fad2a6bfb0e7f1f0f45460944fbd8dfa7f37da06a4d13b3983cc90bb46963b"
+)
+INTEROP_DEPOSIT_SIG0 = bytes.fromhex(
+    "a95af8ff0f8c06af4d29aef05ce865f85f82df42b606008ec5b1bcb42b17ae47"
+    "f4b78cdce1db31ce32d18f42a6b296b4014a2164981780e56b5a40d7723c27b8"
+    "423173e58fa36f075078b177634f66351412b867c103f532aedd50bcd9b98446"
+)
+MINIMAL_GENESIS_FORK_VERSION = bytes.fromhex("00000001")
+
+
+class TestRfc9380Vectors:
+    DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+
+    def test_expand_message_xmd_empty(self):
+        out = expand_message_xmd(b"", self.DST, 0x20)
+        assert out.hex() == (
+            "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+        )
+
+    def test_expand_message_xmd_abc(self):
+        out = expand_message_xmd(b"abc", self.DST, 0x20)
+        assert out.hex() == (
+            "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"
+        )
+
+
+class TestSerializationKats:
+    def test_g1_generator_compressed(self):
+        assert oc.g1_to_bytes(oc.G1_GEN).hex() == (
+            "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+            "6c55e83ff97a1aeffb3af00adb22c6bb"
+        )
+
+    def test_g2_generator_compressed(self):
+        assert oc.g2_to_bytes(oc.G2_GEN).hex() == (
+            "93e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f5049"
+            "334cf11213945d57e5ac7d055d042b7e024aa2b2f08f0a91260805272dc51051"
+            "c6e47ad4fa403b02b4510b647ae3d1770bac0326a805bbefd48056c8c121bdb8"
+        )
+
+    def test_zero_hashes_match_deposit_proof(self):
+        # proof[i] of a single-leaf depth-32 deposit tree == ZERO_HASHES[i]
+        assert ZERO_HASHES[1].hex() == (
+            "f5a5fd42d16a20302798ef6ed309979b43003d2320d9f0e8ea9831a92759fb4b"
+        )
+        assert ZERO_HASHES[2].hex() == (
+            "db56114e00fdd4c1f85c892bf35ac9a89289aaecb1ebd0a96cde606a748b5d71"
+        )
+        assert ZERO_HASHES[29].hex() == (
+            "8869ff2c22b28cc10510d9853292803328be4fb0e80495e8bb8d271f5b889636"
+        )
+        assert ZERO_HASHES[31].hex() == (
+            "985e929f70af28d0bdd1a90a808f977f597c7c778c489e98d3bd8910d31ac0f7"
+        )
+
+
+class TestInteropKats:
+    def test_interop_pubkey_0(self):
+        sk = interop_secret_key(0)
+        assert sk.to_public_key().to_bytes() == INTEROP_PK0
+
+    def test_withdrawal_credentials_0(self):
+        wc = bytearray(hashlib.sha256(INTEROP_PK0).digest())
+        wc[0] = 0
+        assert bytes(wc) == INTEROP_WC0
+
+    def test_deposit_signature_0_matches_blst(self):
+        """End-to-end: SSZ signing root + RFC 9380 hash_to_g2 + G2 mul +
+        compression must reproduce blst's deposit signature bit-for-bit."""
+        sk = interop_secret_key(0)
+        dm = ssz.phase0.DepositMessage(
+            pubkey=INTEROP_PK0,
+            withdrawal_credentials=INTEROP_WC0,
+            amount=32_000_000_000,
+        )
+        domain = compute_domain(DOMAIN_DEPOSIT, MINIMAL_GENESIS_FORK_VERSION)
+        root = compute_signing_root(ssz.phase0.DepositMessage, dm, domain)
+        assert sk.sign(root).to_bytes() == INTEROP_DEPOSIT_SIG0
+
+    def test_deposit_signature_verifies(self):
+        sk = interop_secret_key(0)
+        pk = sk.to_public_key()
+        dm = ssz.phase0.DepositMessage(
+            pubkey=INTEROP_PK0,
+            withdrawal_credentials=INTEROP_WC0,
+            amount=32_000_000_000,
+        )
+        domain = compute_domain(DOMAIN_DEPOSIT, MINIMAL_GENESIS_FORK_VERSION)
+        root = compute_signing_root(ssz.phase0.DepositMessage, dm, domain)
+        sig = api.Signature.from_bytes(INTEROP_DEPOSIT_SIG0)
+        assert api.verify(pk, root, sig)
+        assert not api.verify(pk, b"\x00" * 32, sig)
+
+
+class TestPairingStandard:
+    def test_standard_pairing_cubed_equals_fast_path(self):
+        """pairing() is the cubed pairing; pairing_standard()^3 must equal it."""
+        from lodestar_tpu.crypto.bls import pairing as op
+        from lodestar_tpu.crypto.bls.fields import f12_pow
+
+        std = op.pairing_standard(oc.G1_GEN, oc.G2_GEN)
+        assert f12_pow(std, 3) == op.pairing(oc.G1_GEN, oc.G2_GEN)

@@ -1,0 +1,103 @@
+"""State-level known-answer tests vs reference fixtures (minimal preset).
+
+The interop deposit + genesis state fixtures come from
+/root/reference/packages/beacon-node/test/e2e/interop/genesisState.test.ts,
+produced by @chainsafe/ssz + blst under LODESTAR_PRESET=minimal.  Matching
+the genesis state root bit-for-bit validates the whole stack: SSZ
+merkleization of every phase0 BeaconState field, deposit-tree proofs,
+deposit processing (incl. BLS proof-of-possession), and the genesis
+builder.
+"""
+import numpy as np
+import pytest
+
+from lodestar_tpu.params import ACTIVE_PRESET_NAME
+from lodestar_tpu.config import minimal_chain_config as cfg
+from lodestar_tpu.state_transition.util.genesis import (
+    init_dev_state,
+    initialize_beacon_state_from_eth1,
+    interop_deposits,
+    is_valid_genesis_state,
+)
+from lodestar_tpu.state_transition.util.merkle import is_valid_merkle_branch
+from lodestar_tpu.state_transition.util.misc import (
+    compute_shuffled_index,
+    compute_shuffled_indices_vec,
+)
+from lodestar_tpu.types import ssz
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="fixtures are minimal-preset"
+)
+
+GENESIS_ROOT_KAT = "3ef3bda2cee48ebdbb6f7a478046631bad3b5eeda3543e55d9dd39da230425bb"
+
+
+@pytest.fixture(scope="module")
+def dev_state():
+    deposits, state = init_dev_state(
+        cfg,
+        8,
+        genesis_time=1644000000,
+        eth1_block_hash=b"\xaa" * 32,
+        eth1_timestamp=1644000000,
+    )
+    return deposits, state
+
+
+class TestInteropDeposits:
+    def test_deposit_fixture_validator_0(self):
+        d = interop_deposits(cfg, 1)[0]
+        assert d.data.pubkey.hex().startswith("a99a76ed7796f7be")
+        assert d.data.amount == 32_000_000_000
+        assert d.data.signature.hex().startswith("a95af8ff0f8c06af")
+        # proof: zero-subtree siblings + mix-in-length chunk
+        assert d.proof[0] == b"\x00" * 32
+        assert d.proof[1].hex() == (
+            "f5a5fd42d16a20302798ef6ed309979b43003d2320d9f0e8ea9831a92759fb4b"
+        )
+        assert d.proof[32] == (1).to_bytes(32, "little")
+
+    def test_deposit_proofs_verify(self):
+        deposits = interop_deposits(cfg, 3)
+        # proof i is valid against the tree with leaves 0..i
+        from lodestar_tpu.state_transition.util.merkle import list_tree_root
+
+        roots = [ssz.phase0.DepositData.hash_tree_root(d.data) for d in deposits]
+        for i, d in enumerate(deposits):
+            root = list_tree_root(roots[: i + 1], 32, i + 1)
+            assert is_valid_merkle_branch(roots[i], d.proof, 33, i, root)
+
+
+class TestGenesisState:
+    def test_genesis_state_root_matches_reference(self, dev_state):
+        _, state = dev_state
+        assert ssz.phase0.BeaconState.hash_tree_root(state).hex() == GENESIS_ROOT_KAT
+
+    def test_all_validators_active(self, dev_state):
+        _, state = dev_state
+        assert len(state.validators) == 8
+        assert all(v.activation_epoch == 0 for v in state.validators)
+        assert all(v.effective_balance == 32_000_000_000 for v in state.validators)
+        assert state.eth1_deposit_index == 8
+        assert state.eth1_data.deposit_count == 8
+
+    def test_state_serialization_roundtrip(self, dev_state):
+        _, state = dev_state
+        data = ssz.phase0.BeaconState.serialize(state)
+        rt = ssz.phase0.BeaconState.deserialize(data)
+        assert ssz.phase0.BeaconState.hash_tree_root(rt).hex() == GENESIS_ROOT_KAT
+
+
+class TestShuffling:
+    def test_vectorized_matches_scalar(self):
+        seed = bytes(range(32))
+        for n in (1, 7, 64, 333):
+            vec = compute_shuffled_indices_vec(n, seed)
+            for i in range(0, n, max(1, n // 13)):
+                assert vec[i] == compute_shuffled_index(i, n, seed)
+
+    def test_shuffle_is_permutation(self):
+        seed = b"\x07" * 32
+        vec = compute_shuffled_indices_vec(100, seed)
+        assert sorted(vec.tolist()) == list(range(100))
