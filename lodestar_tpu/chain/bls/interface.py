@@ -1,0 +1,36 @@
+"""BLS verifier plugin boundary — the rebuild's IBlsVerifier.
+
+Reference: packages/beacon-node/src/chain/bls/interface.ts:20.  The chain
+talks only to this interface; implementations are the host-oracle verifier
+(singleThread.ts role) and the TPU device pool (multithread/index.ts:98
+role, with the worker pool replaced by batched device kernels).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol, Sequence
+
+from lodestar_tpu.crypto.bls.api import SignatureSet
+
+
+@dataclass(frozen=True)
+class VerifyOptions:
+    """verifySignatureSets opts (interface.ts:30-46)."""
+
+    # Aggregate this set with other sets in a batch-verification window.
+    # Only safe when the caller tolerates batch-failure retry latency
+    # (gossip objects); block sets use batchable=True too, via chunking.
+    batchable: bool = False
+    # Bypass the device/pool and verify on the host immediately.
+    verify_on_main_thread: bool = False
+
+
+class BlsVerifier(Protocol):
+    async def verify_signature_sets(
+        self, sets: Sequence[SignatureSet], opts: VerifyOptions = VerifyOptions()
+    ) -> bool:
+        """True iff EVERY set verifies."""
+        ...
+
+    async def close(self) -> None:
+        ...

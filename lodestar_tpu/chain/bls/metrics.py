@@ -1,0 +1,51 @@
+"""Prometheus metrics for the BLS verifier pool.
+
+Mirrors the reference's blsThreadPool metric family
+(packages/beacon-node/src/metrics/metrics/lodestar.ts:440-510), feeding the
+same dashboard shapes (dashboards/lodestar_bls_thread_pool.json).
+"""
+from __future__ import annotations
+
+from prometheus_client import Counter, Gauge, Histogram, REGISTRY
+
+
+class BlsPoolMetrics:
+    _instance = None
+
+    def __init__(self, registry=REGISTRY):
+        ns = "lodestar_tpu_bls_pool"
+        self.job_queue_length = Gauge(
+            f"{ns}_queue_length", "Signature sets buffered awaiting a batch", registry=registry
+        )
+        self.jobs_started = Counter(
+            f"{ns}_jobs_started_total", "Device verification jobs launched", registry=registry
+        )
+        self.sig_sets_total = Counter(
+            f"{ns}_sig_sets_total", "Signature sets verified", registry=registry
+        )
+        self.batch_retries = Counter(
+            f"{ns}_batch_retries_total",
+            "Batches that failed and fell back to per-set verification",
+            registry=registry,
+        )
+        self.invalid_sets = Counter(
+            f"{ns}_invalid_sig_sets_total", "Individual sets that failed", registry=registry
+        )
+        self.job_wait_time = Histogram(
+            f"{ns}_job_wait_time_seconds",
+            "Time a set waits in the batching buffer",
+            buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.2, 0.5, 1, 2),
+            registry=registry,
+        )
+        self.job_run_time = Histogram(
+            f"{ns}_job_run_time_seconds",
+            "Device kernel wall time per job",
+            buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 2, 5),
+            registry=registry,
+        )
+
+    @classmethod
+    def get(cls) -> "BlsPoolMetrics":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance

@@ -1,0 +1,32 @@
+"""Host (oracle) BLS verifier — the singleThread.ts role.
+
+Used for tests, tiny dev chains, and as the CPU fallback when no device is
+available (reference: packages/beacon-node/src/chain/bls/singleThread.ts).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from lodestar_tpu.crypto.bls.api import (
+    SignatureSet,
+    verify_multiple_signature_sets,
+    verify_signature_set,
+)
+from .interface import VerifyOptions
+
+
+class SingleThreadBlsVerifier:
+    async def verify_signature_sets(
+        self, sets: Sequence[SignatureSet], opts: VerifyOptions = VerifyOptions()
+    ) -> bool:
+        if not sets:
+            return False
+        if len(sets) == 1:
+            return verify_signature_set(sets[0])
+        # batch with retry-each-individually on failure (maybeBatch.ts:17)
+        if verify_multiple_signature_sets(list(sets)):
+            return True
+        return all(verify_signature_set(s) for s in sets)
+
+    async def close(self) -> None:
+        return None

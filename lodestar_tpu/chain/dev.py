@@ -1,0 +1,216 @@
+"""In-process dev chain: interop validators producing and importing blocks.
+
+The engine behind the `dev` command (reference: packages/cli/src/cmds/dev/
+plus chain/produceBlock/produceBlockBody.ts in miniature): every slot the
+scheduled interop validator proposes a block carrying the previous slot's
+attestations, the block runs through the full state transition, and its
+signature sets verify through the pluggable BLS verifier.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+)
+from lodestar_tpu.state_transition import CachedBeaconState, process_slots, state_transition
+from lodestar_tpu.state_transition.block.phase0 import get_domain
+from lodestar_tpu.state_transition.signature_sets import get_block_signature_sets
+from lodestar_tpu.state_transition.util.domain import compute_signing_root
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.state_transition.util.interop import interop_secret_keys
+from lodestar_tpu.state_transition.util.misc import (
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    get_block_root_at_slot,
+)
+from lodestar_tpu.types import ssz
+
+
+@dataclass
+class ImportedBlock:
+    root: bytes
+    block: "ssz.phase0.SignedBeaconBlock"
+    post_state: CachedBeaconState
+
+
+class DevChain:
+    """Single-node in-memory chain of interop validators."""
+
+    def __init__(self, cfg, validator_count: int, genesis_time: int = 0):
+        self.cfg = cfg
+        self.sks = interop_secret_keys(validator_count)
+        _, state = init_dev_state(cfg, validator_count, genesis_time=genesis_time)
+        self.head = CachedBeaconState(cfg, state)
+        self.blocks: Dict[bytes, ImportedBlock] = {}
+        self.pending_atts: List["ssz.phase0.Attestation"] = []
+        self.verified_set_count = 0
+
+    # ------------------------------------------------------------------
+
+    def _head_root(self) -> bytes:
+        """Root of the head block: the latest header with its state_root
+        filled the way the next process_slot will fill it."""
+        hdr = self.head.state.latest_block_header
+        hdr = ssz.phase0.BeaconBlockHeader(
+            slot=hdr.slot,
+            proposer_index=hdr.proposer_index,
+            parent_root=hdr.parent_root,
+            state_root=hdr.state_root,
+            body_root=hdr.body_root,
+        )
+        if bytes(hdr.state_root) == b"\x00" * 32:
+            hdr.state_root = self.head.hash_tree_root()
+        return ssz.phase0.BeaconBlockHeader.hash_tree_root(hdr)
+
+    def attest(self, slot: int) -> List["ssz.phase0.Attestation"]:
+        """All committees of `slot` attest to the current head (validator
+        spec produce-attestation, simplified to full participation)."""
+        state_at = self.head.clone()
+        if state_at.state.slot < slot:
+            process_slots(state_at, slot)
+        st = state_at.state
+        epoch = compute_epoch_at_slot(slot)
+        head_root = self._head_root()
+        start_slot = compute_start_slot_at_epoch(epoch)
+        if start_slot == st.slot:
+            target_root = head_root
+        else:
+            target_root = get_block_root_at_slot(st, start_slot)
+        atts = []
+        cps = state_at.epoch_ctx.get_committee_count_per_slot(epoch)
+        for index in range(cps):
+            committee = state_at.epoch_ctx.get_committee(slot, index)
+            if len(committee) == 0:
+                continue
+            data = ssz.phase0.AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=st.current_justified_checkpoint,
+                target=ssz.phase0.Checkpoint(epoch=epoch, root=target_root),
+            )
+            domain = get_domain(self.cfg, st, DOMAIN_BEACON_ATTESTER, epoch)
+            root = compute_signing_root(ssz.phase0.AttestationData, data, domain)
+            sigs = [self.sks[int(v)].sign(root) for v in committee]
+            atts.append(
+                ssz.phase0.Attestation(
+                    aggregation_bits=[True] * len(committee),
+                    data=data,
+                    signature=bls.aggregate_signatures(sigs).to_bytes(),
+                )
+            )
+        self.pending_atts.extend(atts)
+        return atts
+
+    def produce_block(self, slot: int) -> "ssz.phase0.SignedBeaconBlock":
+        pre = self.head.clone()
+        process_slots(pre, slot)
+        proposer = pre.epoch_ctx.get_beacon_proposer(slot)
+        sk = self.sks[proposer]
+        epoch = compute_epoch_at_slot(slot)
+
+        randao_domain = get_domain(self.cfg, pre.state, DOMAIN_RANDAO, epoch)
+        randao_reveal = sk.sign(
+            compute_signing_root(ssz.phase0.Epoch, epoch, randao_domain)
+        ).to_bytes()
+
+        atts = [
+            a
+            for a in self.pending_atts
+            if a.data.slot + _p.MIN_ATTESTATION_INCLUSION_DELAY <= slot <= a.data.slot + _p.SLOTS_PER_EPOCH
+        ][: _p.MAX_ATTESTATIONS]
+
+        body = ssz.phase0.BeaconBlockBody(
+            randao_reveal=randao_reveal,
+            eth1_data=pre.state.eth1_data,
+            graffiti=b"lodestar-tpu-dev".ljust(32, b"\x00"),
+            attestations=atts,
+        )
+        block = ssz.phase0.BeaconBlock(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=self._head_root(),
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        # compute the post-state root (produceBlock/computeNewStateRoot.ts)
+        trial = ssz.phase0.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
+        post = state_transition(
+            self.head,
+            trial,
+            verify_state_root=False,
+            verify_proposer=False,
+            verify_signatures=False,
+        )
+        block.state_root = post.hash_tree_root()
+
+        domain = get_domain(self.cfg, pre.state, DOMAIN_BEACON_PROPOSER, epoch)
+        sig = sk.sign(
+            compute_signing_root(ssz.phase0.BeaconBlock, block, domain)
+        ).to_bytes()
+        return ssz.phase0.SignedBeaconBlock(message=block, signature=sig)
+
+    def import_block(
+        self, signed_block, verifier=None, verify_signatures: bool = True
+    ) -> ImportedBlock:
+        """Full import: STF + signature sets through the verifier (the
+        3-way-parallel import pipeline collapsed to sequential host code;
+        the async pipeline lives in chain/blocks.py)."""
+        pre = self.head
+        if verify_signatures:
+            post = state_transition(
+                pre, signed_block, verify_state_root=True,
+                verify_proposer=False, verify_signatures=False,
+            )
+            sets = get_block_signature_sets(
+                self.cfg, post.state, post.epoch_ctx, signed_block
+            )
+            if verifier is None:
+                ok = bls.verify_multiple_signature_sets(sets)
+            else:
+                import asyncio
+
+                ok = asyncio.run(verifier.verify_signature_sets(sets))
+            if not ok:
+                raise ValueError("block signature sets failed verification")
+            self.verified_set_count += len(sets)
+        else:
+            post = state_transition(
+                pre, signed_block, verify_state_root=True,
+                verify_proposer=False, verify_signatures=False,
+            )
+        root = ssz.phase0.BeaconBlock.hash_tree_root(signed_block.message)
+        imported = ImportedBlock(root=root, block=signed_block, post_state=post)
+        self.blocks[root] = imported
+        self.head = post
+        consumed = {
+            ssz.phase0.AttestationData.hash_tree_root(a.data)
+            for a in signed_block.message.body.attestations
+        }
+        self.pending_atts = [
+            a
+            for a in self.pending_atts
+            if ssz.phase0.AttestationData.hash_tree_root(a.data) not in consumed
+        ]
+        return imported
+
+    # ------------------------------------------------------------------
+
+    def run_slot(self, slot: int, verifier=None, verify_signatures: bool = True):
+        """One full slot: attest at slot-1, propose+import at `slot`."""
+        if slot > 1:
+            self.attest(slot - 1)
+        block = self.produce_block(slot)
+        return self.import_block(block, verifier, verify_signatures)
+
+    def run_until(self, slot: int, verifier=None, verify_signatures: bool = True):
+        start = self.head.state.slot + 1
+        for s in range(start, slot + 1):
+            self.run_slot(s, verifier, verify_signatures)
+        return self.head

@@ -77,6 +77,9 @@ class ForkChoice:
         self.proposer_boost_root: Optional[str] = None
         self.proposer_boost_enabled = proposer_boost_enabled
         self._justified_proposer_boost_score: Optional[int] = None
+        # balances the current proto-array weights were computed with —
+        # compute_deltas needs (old, new) to rebalance on justified change
+        self._applied_balances: Sequence[int] = store.justified_balances
         self.head: Optional[ProtoNode] = None
 
     # ------------------------------------------------------------------
@@ -88,10 +91,11 @@ class ForkChoice:
         deltas = compute_deltas(
             self.proto_array.indices,
             self.votes,
-            balances,
+            self._applied_balances,
             balances,
             self.store.equivocating_indices,
         )
+        self._applied_balances = balances
         boost = None
         if self.proposer_boost_enabled and self.proposer_boost_root:
             if self._justified_proposer_boost_score is None:

@@ -546,14 +546,18 @@ class Container(metaclass=ContainerMeta):
         object.__setattr__(self, name, value)
 
     def copy(self):
-        """Shallow-ish copy: nested containers/lists copied one level deep."""
+        """Deep value copy: nested containers and container-list elements
+        are copied recursively so no mutable object is shared with the
+        original (bytes/int/bool values are immutable and shared freely).
+        This is the correctness baseline; the structural-sharing fast path
+        belongs to a tree-backed view layer (reference stateCache.ts)."""
         kwargs = {}
         for n in type(self)._fields_:
             v = getattr(self, n)
             if isinstance(v, Container):
                 v = v.copy()
             elif isinstance(v, list):
-                v = list(v)
+                v = [e.copy() if isinstance(e, Container) else e for e in v]
             kwargs[n] = v
         return type(self)(**kwargs)
 

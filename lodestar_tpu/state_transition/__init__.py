@@ -1,0 +1,7 @@
+from .epoch_context import EpochContext, EpochShuffling, compute_epoch_shuffling  # noqa: F401
+from .state_transition import (  # noqa: F401
+    CachedBeaconState,
+    process_slot,
+    process_slots,
+    state_transition,
+)

@@ -1,0 +1,395 @@
+"""Phase0 block processing (reference:
+packages/state-transition/src/block/*.ts, consensus-specs phase0).
+
+All functions mutate `state` in place and raise ValueError on invalid
+blocks.  Signature verification is SEPARABLE: pass verify_signatures=False
+and feed the extracted signature sets to the BLS verifier instead (the
+reference's verifyBlocksSignatures / getBlockSignatureSets split,
+chain/blocks/verifyBlock.ts:71-80) — the TPU-first import pipeline runs
+the state transition and the device batch verification in parallel.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_VOLUNTARY_EXIT,
+    FAR_FUTURE_EPOCH,
+    ForkName,
+)
+from lodestar_tpu.types import ssz
+from ..epoch_context import EpochContext
+from ..util.domain import compute_signing_root
+from ..util.misc import (
+    compute_activation_exit_epoch,
+    compute_epoch_at_slot,
+    decrease_balance,
+    get_randao_mix,
+    get_validator_churn_limit,
+    increase_balance,
+    int_to_bytes,
+    is_active_validator,
+    sha256,
+)
+from .process_deposit import process_deposit
+
+
+def get_domain(cfg, state, domain_type: bytes, epoch: Optional[int] = None) -> bytes:
+    """spec get_domain using the state's fork + genesis_validators_root."""
+    from ..util.domain import compute_domain
+
+    epoch = compute_epoch_at_slot(state.slot) if epoch is None else epoch
+    fork_version = (
+        state.fork.previous_version
+        if epoch < state.fork.epoch
+        else state.fork.current_version
+    )
+    return compute_domain(domain_type, fork_version, state.genesis_validators_root)
+
+
+# ---------------------------------------------------------------------------
+# header / randao / eth1
+# ---------------------------------------------------------------------------
+
+
+def process_block_header(cfg, state, epoch_ctx: EpochContext, block) -> None:
+    if block.slot != state.slot:
+        raise ValueError(f"block slot {block.slot} != state slot {state.slot}")
+    if block.slot <= state.latest_block_header.slot:
+        raise ValueError("block older than latest header")
+    if block.proposer_index != epoch_ctx.get_beacon_proposer(block.slot):
+        raise ValueError("wrong proposer index")
+    parent_root = ssz.phase0.BeaconBlockHeader.hash_tree_root(
+        state.latest_block_header
+    )
+    if bytes(block.parent_root) != parent_root:
+        raise ValueError("parent root mismatch")
+    body_t = type(block)._fields_["body"]
+    state.latest_block_header = ssz.phase0.BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=b"\x00" * 32,  # filled at next process_slot
+        body_root=body_t.hash_tree_root(block.body),
+    )
+    proposer = state.validators[block.proposer_index]
+    if proposer.slashed:
+        raise ValueError("proposer slashed")
+
+
+def process_randao(
+    cfg, state, epoch_ctx: EpochContext, body, verify_signature: bool = True
+) -> None:
+    epoch = compute_epoch_at_slot(state.slot)
+    if verify_signature:
+        proposer = state.validators[epoch_ctx.get_beacon_proposer(state.slot)]
+        domain = get_domain(cfg, state, DOMAIN_RANDAO)
+        root = compute_signing_root(
+            ssz.phase0.Epoch, epoch, domain
+        )
+        if not bls.verify(
+            bls.PublicKey.from_bytes(bytes(proposer.pubkey)),
+            root,
+            bls.Signature.from_bytes(bytes(body.randao_reveal)),
+        ):
+            raise ValueError("invalid randao reveal")
+    mix = bytes(
+        a ^ b
+        for a, b in zip(
+            get_randao_mix(state, epoch), sha256(bytes(body.randao_reveal))
+        )
+    )
+    state.randao_mixes[epoch % _p.EPOCHS_PER_HISTORICAL_VECTOR] = mix
+
+
+def process_eth1_data(cfg, state, body) -> None:
+    state.eth1_data_votes.append(body.eth1_data)
+    votes = sum(
+        1 for v in state.eth1_data_votes if v == body.eth1_data
+    )
+    period_slots = _p.EPOCHS_PER_ETH1_VOTING_PERIOD * _p.SLOTS_PER_EPOCH
+    if votes * 2 > period_slots:
+        state.eth1_data = body.eth1_data
+
+
+# ---------------------------------------------------------------------------
+# slashings / exits
+# ---------------------------------------------------------------------------
+
+
+def initiate_validator_exit(cfg, state, epoch_ctx, index: int) -> None:
+    """Queue a validator exit.  The exit-queue scan is O(V) ONCE per epoch
+    context and updated incrementally thereafter (the reference caches
+    exitQueueEpoch/exitQueueChurn/churnLimit on EpochContext the same way,
+    epochContext.ts initiateValidatorExit)."""
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    epoch = compute_epoch_at_slot(state.slot)
+    if epoch_ctx.exit_queue_epoch is None:
+        exit_epochs = [
+            u.exit_epoch for u in state.validators if u.exit_epoch != FAR_FUTURE_EPOCH
+        ]
+        eq = max(exit_epochs + [compute_activation_exit_epoch(epoch)])
+        epoch_ctx.exit_queue_epoch = eq
+        epoch_ctx.exit_queue_churn = sum(
+            1 for u in state.validators if u.exit_epoch == eq
+        )
+        epoch_ctx.churn_limit = get_validator_churn_limit(
+            cfg, sum(1 for u in state.validators if is_active_validator(u, epoch))
+        )
+    else:
+        # keep the floor in sync with the advancing epoch
+        floor = compute_activation_exit_epoch(epoch)
+        if floor > epoch_ctx.exit_queue_epoch:
+            epoch_ctx.exit_queue_epoch = floor
+            epoch_ctx.exit_queue_churn = 0
+    if epoch_ctx.exit_queue_churn >= epoch_ctx.churn_limit:
+        epoch_ctx.exit_queue_epoch += 1
+        epoch_ctx.exit_queue_churn = 0
+    epoch_ctx.exit_queue_churn += 1
+    v.exit_epoch = epoch_ctx.exit_queue_epoch
+    v.withdrawable_epoch = (
+        epoch_ctx.exit_queue_epoch + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+
+
+def slash_validator(
+    cfg, state, epoch_ctx: EpochContext, index: int, whistleblower: Optional[int] = None
+) -> None:
+    epoch = compute_epoch_at_slot(state.slot)
+    initiate_validator_exit(cfg, state, epoch_ctx, index)
+    v = state.validators[index]
+    v.slashed = True
+    v.withdrawable_epoch = max(
+        v.withdrawable_epoch, epoch + _p.EPOCHS_PER_SLASHINGS_VECTOR
+    )
+    state.slashings[epoch % _p.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
+    decrease_balance(
+        state, index, v.effective_balance // _p.MIN_SLASHING_PENALTY_QUOTIENT
+    )
+    proposer_index = epoch_ctx.get_beacon_proposer(state.slot)
+    whistleblower_index = whistleblower if whistleblower is not None else proposer_index
+    whistleblower_reward = v.effective_balance // _p.WHISTLEBLOWER_REWARD_QUOTIENT
+    proposer_reward = whistleblower_reward // _p.PROPOSER_REWARD_QUOTIENT
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)
+
+
+def is_slashable_validator(v, epoch: int) -> bool:
+    return (not v.slashed) and v.activation_epoch <= epoch < v.withdrawable_epoch
+
+
+def is_slashable_attestation_data(d1, d2) -> bool:
+    # double vote or surround vote
+    return (
+        d1 != d2 and d1.target.epoch == d2.target.epoch
+    ) or (d1.source.epoch < d2.source.epoch and d2.target.epoch < d1.target.epoch)
+
+
+def is_valid_indexed_attestation(
+    cfg, state, indexed, verify_signature: bool = True
+) -> bool:
+    indices = list(indexed.attesting_indices)
+    if not indices or indices != sorted(set(indices)):
+        return False
+    if verify_signature:
+        pubkeys = [
+            bls.PublicKey.from_bytes(bytes(state.validators[i].pubkey))
+            for i in indices
+        ]
+        domain = get_domain(
+            cfg, state, DOMAIN_BEACON_ATTESTER, indexed.data.target.epoch
+        )
+        root = compute_signing_root(
+            ssz.phase0.AttestationData, indexed.data, domain
+        )
+        return bls.fast_aggregate_verify(
+            pubkeys, root, bls.Signature.from_bytes(bytes(indexed.signature))
+        )
+    return True
+
+
+def process_proposer_slashing(
+    cfg, state, epoch_ctx: EpochContext, ps, verify_signatures: bool = True
+) -> None:
+    h1, h2 = ps.signed_header_1.message, ps.signed_header_2.message
+    if h1.slot != h2.slot:
+        raise ValueError("proposer slashing: different slots")
+    if h1.proposer_index != h2.proposer_index:
+        raise ValueError("proposer slashing: different proposers")
+    if h1 == h2:
+        raise ValueError("proposer slashing: identical headers")
+    proposer = state.validators[h1.proposer_index]
+    if not is_slashable_validator(proposer, compute_epoch_at_slot(state.slot)):
+        raise ValueError("proposer not slashable")
+    if verify_signatures:
+        for signed in (ps.signed_header_1, ps.signed_header_2):
+            domain = get_domain(
+                cfg,
+                state,
+                DOMAIN_BEACON_PROPOSER,
+                compute_epoch_at_slot(signed.message.slot),
+            )
+            root = compute_signing_root(
+                ssz.phase0.BeaconBlockHeader, signed.message, domain
+            )
+            if not bls.verify(
+                bls.PublicKey.from_bytes(bytes(proposer.pubkey)),
+                root,
+                bls.Signature.from_bytes(bytes(signed.signature)),
+            ):
+                raise ValueError("proposer slashing: bad signature")
+    slash_validator(cfg, state, epoch_ctx, h1.proposer_index)
+
+
+def process_attester_slashing(
+    cfg, state, epoch_ctx: EpochContext, att_slashing, verify_signatures: bool = True
+) -> None:
+    a1, a2 = att_slashing.attestation_1, att_slashing.attestation_2
+    if not is_slashable_attestation_data(a1.data, a2.data):
+        raise ValueError("attestations not slashable")
+    for a in (a1, a2):
+        if not is_valid_indexed_attestation(cfg, state, a, verify_signatures):
+            raise ValueError("invalid indexed attestation")
+    epoch = compute_epoch_at_slot(state.slot)
+    slashed_any = False
+    common = set(a1.attesting_indices) & set(a2.attesting_indices)
+    for index in sorted(common):
+        if is_slashable_validator(state.validators[index], epoch):
+            slash_validator(cfg, state, epoch_ctx, index)
+            slashed_any = True
+    if not slashed_any:
+        raise ValueError("no slashable indices")
+
+
+def process_voluntary_exit(
+    cfg, state, epoch_ctx, signed_exit, verify_signature: bool = True
+) -> None:
+    exit_ = signed_exit.message
+    v = state.validators[exit_.validator_index]
+    epoch = compute_epoch_at_slot(state.slot)
+    if not is_active_validator(v, epoch):
+        raise ValueError("exit: validator not active")
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        raise ValueError("exit: already exiting")
+    if epoch < exit_.epoch:
+        raise ValueError("exit: not yet valid")
+    if epoch < v.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD:
+        raise ValueError("exit: too young")
+    if verify_signature:
+        domain = get_domain(cfg, state, DOMAIN_VOLUNTARY_EXIT, exit_.epoch)
+        root = compute_signing_root(ssz.phase0.VoluntaryExit, exit_, domain)
+        if not bls.verify(
+            bls.PublicKey.from_bytes(bytes(v.pubkey)),
+            root,
+            bls.Signature.from_bytes(bytes(signed_exit.signature)),
+        ):
+            raise ValueError("exit: bad signature")
+    initiate_validator_exit(cfg, state, epoch_ctx, exit_.validator_index)
+
+
+# ---------------------------------------------------------------------------
+# attestations
+# ---------------------------------------------------------------------------
+
+
+def get_attesting_indices(epoch_ctx: EpochContext, data, aggregation_bits) -> List[int]:
+    committee = epoch_ctx.get_committee(data.slot, data.index)
+    if len(aggregation_bits) != len(committee):
+        raise ValueError("aggregation bits length mismatch")
+    return [int(committee[i]) for i, bit in enumerate(aggregation_bits) if bit]
+
+
+def get_indexed_attestation(epoch_ctx: EpochContext, attestation):
+    indices = get_attesting_indices(
+        epoch_ctx, attestation.data, attestation.aggregation_bits
+    )
+    return ssz.phase0.IndexedAttestation(
+        attesting_indices=sorted(indices),
+        data=attestation.data,
+        signature=attestation.signature,
+    )
+
+
+def process_attestation(
+    cfg, state, epoch_ctx: EpochContext, attestation, verify_signature: bool = True
+) -> None:
+    data = attestation.data
+    epoch = compute_epoch_at_slot(state.slot)
+    previous_epoch = max(0, epoch - 1)
+    if data.target.epoch not in (previous_epoch, epoch):
+        raise ValueError("attestation target epoch out of range")
+    if data.target.epoch != compute_epoch_at_slot(data.slot):
+        raise ValueError("attestation target/slot mismatch")
+    if not (
+        data.slot + _p.MIN_ATTESTATION_INCLUSION_DELAY
+        <= state.slot
+        <= data.slot + _p.SLOTS_PER_EPOCH
+    ):
+        raise ValueError("attestation inclusion window")
+    if data.index >= epoch_ctx.get_committee_count_per_slot(data.target.epoch):
+        raise ValueError("attestation committee index out of range")
+
+    pending = ssz.phase0.PendingAttestation(
+        aggregation_bits=list(attestation.aggregation_bits),
+        data=data,
+        inclusion_delay=state.slot - data.slot,
+        proposer_index=epoch_ctx.get_beacon_proposer(state.slot),
+    )
+    if data.target.epoch == epoch:
+        if data.source != state.current_justified_checkpoint:
+            raise ValueError("attestation source != current justified")
+        state.current_epoch_attestations.append(pending)
+    else:
+        if data.source != state.previous_justified_checkpoint:
+            raise ValueError("attestation source != previous justified")
+        state.previous_epoch_attestations.append(pending)
+
+    indexed = get_indexed_attestation(epoch_ctx, attestation)
+    if not is_valid_indexed_attestation(cfg, state, indexed, verify_signature):
+        raise ValueError("invalid attestation (indices/signature)")
+
+
+# ---------------------------------------------------------------------------
+# the block body
+# ---------------------------------------------------------------------------
+
+
+def process_operations(
+    cfg, state, epoch_ctx: EpochContext, body, verify_signatures: bool = True
+) -> None:
+    expected_deposits = min(
+        _p.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    if len(body.deposits) != expected_deposits:
+        raise ValueError(
+            f"expected {expected_deposits} deposits, block has {len(body.deposits)}"
+        )
+    for ps in body.proposer_slashings:
+        process_proposer_slashing(cfg, state, epoch_ctx, ps, verify_signatures)
+    for asl in body.attester_slashings:
+        process_attester_slashing(cfg, state, epoch_ctx, asl, verify_signatures)
+    for att in body.attestations:
+        process_attestation(cfg, state, epoch_ctx, att, verify_signatures)
+    for dep in body.deposits:
+        process_deposit(
+            ForkName.phase0, cfg, state, dep, epoch_ctx.pubkey2index
+        )
+    for ex in body.voluntary_exits:
+        process_voluntary_exit(cfg, state, epoch_ctx, ex, verify_signatures)
+
+
+def process_block(
+    cfg, state, epoch_ctx: EpochContext, block, verify_signatures: bool = True
+) -> None:
+    process_block_header(cfg, state, epoch_ctx, block)
+    process_randao(cfg, state, epoch_ctx, block.body, verify_signatures)
+    process_eth1_data(cfg, state, block.body)
+    process_operations(cfg, state, epoch_ctx, block.body, verify_signatures)

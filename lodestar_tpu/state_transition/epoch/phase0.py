@@ -1,0 +1,397 @@
+"""Phase0 epoch processing (reference:
+packages/state-transition/src/epoch/*.ts; consensus-specs phase0).
+
+The O(V) work runs over flat numpy arrays assembled once per transition
+(the reference's beforeProcessEpoch / EpochProcess pattern,
+cache/epochProcess.ts:126-140): per-validator participation flags,
+effective balances, inclusion delays.  The tree-backed state is only
+touched to read pending attestations and write back results.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    BASE_REWARDS_PER_EPOCH,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+)
+from lodestar_tpu.types import ssz
+from ..epoch_context import EpochContext
+from ..util.misc import (
+    compute_activation_exit_epoch,
+    compute_epoch_at_slot,
+    get_block_root,
+    get_block_root_at_slot,
+    get_randao_mix,
+    get_validator_churn_limit,
+    int_to_bytes,
+)
+
+FLAG_PREV_SOURCE = 1 << 0
+FLAG_PREV_TARGET = 1 << 1
+FLAG_PREV_HEAD = 1 << 2
+FLAG_CURR_SOURCE = 1 << 3
+FLAG_CURR_TARGET = 1 << 4
+FLAG_UNSLASHED = 1 << 5
+FLAG_ELIGIBLE = 1 << 6
+
+
+@dataclass
+class EpochProcess:
+    """Flat per-validator arrays for one epoch transition."""
+
+    current_epoch: int
+    previous_epoch: int
+    total_active_balance: int
+    flags: np.ndarray               # uint8 flag bytes
+    effective_balances: np.ndarray  # int64 gwei
+    is_active_prev: np.ndarray      # bool
+    is_active_curr: np.ndarray
+    # earliest-inclusion info for prev-epoch source attesters
+    inclusion_delay: np.ndarray     # int64 (0 = none)
+    inclusion_proposer: np.ndarray  # int64 (-1 = none)
+    balances: Optional[np.ndarray] = None
+
+
+def _attesting_flags(state, epoch_ctx, attestations, epoch, flags, source_flag, target_flag, head_flag, incl_delay=None, incl_proposer=None):
+    try:
+        target_root = get_block_root(state, epoch)
+    except ValueError:
+        target_root = None
+    for att in attestations:
+        data = att.data
+        committee = epoch_ctx.get_committee(data.slot, data.index)
+        indices = [int(committee[i]) for i, b in enumerate(att.aggregation_bits) if b]
+        matching_target = target_root is not None and bytes(data.target.root) == target_root
+        matching_head = False
+        if matching_target:
+            try:
+                matching_head = bytes(data.beacon_block_root) == get_block_root_at_slot(
+                    state, data.slot
+                )
+            except ValueError:
+                matching_head = False
+        for i in indices:
+            flags[i] |= source_flag
+            if matching_target:
+                flags[i] |= target_flag
+            if matching_head:
+                flags[i] |= head_flag
+            if incl_delay is not None:
+                d = att.inclusion_delay
+                if incl_delay[i] == 0 or d < incl_delay[i]:
+                    incl_delay[i] = d
+                    incl_proposer[i] = att.proposer_index
+
+
+def before_process_epoch(cfg, state, epoch_ctx: EpochContext) -> EpochProcess:
+    n = len(state.validators)
+    current_epoch = compute_epoch_at_slot(state.slot)
+    previous_epoch = max(GENESIS_EPOCH, current_epoch - 1)
+
+    eff = np.array([v.effective_balance for v in state.validators], dtype=np.int64)
+    slashed = np.array([v.slashed for v in state.validators], dtype=bool)
+    activation = np.array(
+        [v.activation_epoch for v in state.validators], dtype=np.float64
+    )
+    exit_e = np.array([v.exit_epoch for v in state.validators], dtype=np.float64)
+    withdrawable = np.array(
+        [v.withdrawable_epoch for v in state.validators], dtype=np.float64
+    )
+
+    is_active_prev = (activation <= previous_epoch) & (previous_epoch < exit_e)
+    is_active_curr = (activation <= current_epoch) & (current_epoch < exit_e)
+
+    flags = np.zeros(n, dtype=np.uint8)
+    flags[~slashed] |= FLAG_UNSLASHED
+    eligible = is_active_prev | (slashed & (previous_epoch + 1 < withdrawable))
+    flags[eligible] |= FLAG_ELIGIBLE
+
+    incl_delay = np.zeros(n, dtype=np.int64)
+    incl_proposer = np.full(n, -1, dtype=np.int64)
+
+    _attesting_flags(
+        state, epoch_ctx, state.previous_epoch_attestations, previous_epoch,
+        flags, FLAG_PREV_SOURCE, FLAG_PREV_TARGET, FLAG_PREV_HEAD,
+        incl_delay, incl_proposer,
+    )
+    _attesting_flags(
+        state, epoch_ctx, state.current_epoch_attestations, current_epoch,
+        flags, FLAG_CURR_SOURCE, FLAG_CURR_TARGET, 0,
+    )
+
+    total_active = int(eff[is_active_curr].sum())
+    return EpochProcess(
+        current_epoch=current_epoch,
+        previous_epoch=previous_epoch,
+        total_active_balance=max(_p.EFFECTIVE_BALANCE_INCREMENT, total_active),
+        flags=flags,
+        effective_balances=eff,
+        is_active_prev=is_active_prev,
+        is_active_curr=is_active_curr,
+        inclusion_delay=incl_delay,
+        inclusion_proposer=incl_proposer,
+    )
+
+
+def _unslashed_attesting_balance(proc: EpochProcess, flag: int) -> int:
+    m = ((proc.flags & flag) != 0) & ((proc.flags & FLAG_UNSLASHED) != 0)
+    return max(
+        _p.EFFECTIVE_BALANCE_INCREMENT, int(proc.effective_balances[m].sum())
+    )
+
+
+# ---------------------------------------------------------------------------
+# justification & finalization
+# ---------------------------------------------------------------------------
+
+
+def process_justification_and_finalization(cfg, state, proc: EpochProcess) -> None:
+    if proc.current_epoch <= GENESIS_EPOCH + 1:
+        return
+    prev_target = _unslashed_attesting_balance(proc, FLAG_PREV_TARGET)
+    curr_target = _unslashed_attesting_balance(proc, FLAG_CURR_TARGET)
+    weigh_justification_and_finalization(
+        cfg, state, proc.total_active_balance, prev_target, curr_target
+    )
+
+
+def weigh_justification_and_finalization(
+    cfg, state, total_balance: int, previous_target: int, current_target: int
+) -> None:
+    current_epoch = compute_epoch_at_slot(state.slot)
+    previous_epoch = current_epoch - 1
+    old_prev = state.previous_justified_checkpoint
+    old_curr = state.current_justified_checkpoint
+    bits = list(state.justification_bits)
+
+    state.previous_justified_checkpoint = state.current_justified_checkpoint
+    bits = [False] + bits[:-1]
+
+    if previous_target * 3 >= total_balance * 2:
+        state.current_justified_checkpoint = ssz.phase0.Checkpoint(
+            epoch=previous_epoch, root=get_block_root(state, previous_epoch)
+        )
+        bits[1] = True
+    if current_target * 3 >= total_balance * 2:
+        state.current_justified_checkpoint = ssz.phase0.Checkpoint(
+            epoch=current_epoch, root=get_block_root(state, current_epoch)
+        )
+        bits[0] = True
+    state.justification_bits = bits
+
+    # finalization rules
+    if all(bits[1:4]) and old_prev.epoch + 3 == current_epoch:
+        state.finalized_checkpoint = old_prev
+    if all(bits[1:3]) and old_prev.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_prev
+    if all(bits[0:3]) and old_curr.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_curr
+    if all(bits[0:2]) and old_curr.epoch + 1 == current_epoch:
+        state.finalized_checkpoint = old_curr
+
+
+# ---------------------------------------------------------------------------
+# rewards & penalties (vectorized phase0 deltas)
+# ---------------------------------------------------------------------------
+
+
+def is_in_inactivity_leak(proc: EpochProcess, state) -> bool:
+    return finality_delay(proc, state) > _p.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+
+def finality_delay(proc: EpochProcess, state) -> int:
+    return proc.previous_epoch - state.finalized_checkpoint.epoch
+
+
+def get_attestation_deltas(cfg, state, proc: EpochProcess):
+    """Vectorized phase0 get_attestation_deltas: returns (rewards,
+    penalties) int64 arrays."""
+    n = len(proc.flags)
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+    sqrt_total = int(math.isqrt(proc.total_active_balance))
+    base_rewards = (
+        proc.effective_balances * _p.BASE_REWARD_FACTOR // sqrt_total // BASE_REWARDS_PER_EPOCH
+    )
+    proposer_rewards = base_rewards // _p.PROPOSER_REWARD_QUOTIENT
+    eligible = (proc.flags & FLAG_ELIGIBLE) != 0
+    unslashed = (proc.flags & FLAG_UNSLASHED) != 0
+    in_leak = is_in_inactivity_leak(proc, state)
+    total_incr = proc.total_active_balance // _p.EFFECTIVE_BALANCE_INCREMENT
+
+    for flag in (FLAG_PREV_SOURCE, FLAG_PREV_TARGET, FLAG_PREV_HEAD):
+        participated = ((proc.flags & flag) != 0) & unslashed
+        comp_balance = _unslashed_attesting_balance(proc, flag)
+        comp_incr = comp_balance // _p.EFFECTIVE_BALANCE_INCREMENT
+        mask_r = eligible & participated
+        mask_p = eligible & ~participated
+        if in_leak:
+            rewards[mask_r] += base_rewards[mask_r]
+        else:
+            rewards[mask_r] += (
+                base_rewards[mask_r] * comp_incr // total_incr
+            )
+        penalties[mask_p] += base_rewards[mask_p]
+
+    # inclusion delay: earliest matching-source inclusion
+    src = ((proc.flags & FLAG_PREV_SOURCE) != 0) & unslashed & (proc.inclusion_delay > 0)
+    idx = np.nonzero(src)[0]
+    for i in idx:
+        max_attester = base_rewards[i] - proposer_rewards[i]
+        rewards[i] += max_attester // proc.inclusion_delay[i]
+        p = proc.inclusion_proposer[i]
+        if p >= 0:
+            rewards[p] += proposer_rewards[i]
+
+    if in_leak:
+        delay = finality_delay(proc, state)
+        penalties[eligible] += (
+            BASE_REWARDS_PER_EPOCH * base_rewards[eligible] - proposer_rewards[eligible]
+        )
+        not_target = eligible & ~(((proc.flags & FLAG_PREV_TARGET) != 0) & unslashed)
+        penalties[not_target] += (
+            proc.effective_balances[not_target] * delay // _p.INACTIVITY_PENALTY_QUOTIENT
+        )
+    return rewards, penalties
+
+
+def process_rewards_and_penalties(cfg, state, proc: EpochProcess) -> None:
+    if proc.current_epoch == GENESIS_EPOCH:
+        return
+    rewards, penalties = get_attestation_deltas(cfg, state, proc)
+    balances = np.array(state.balances, dtype=np.int64)
+    balances = np.maximum(0, balances + rewards - penalties)
+    for i, b in enumerate(balances):
+        state.balances[i] = int(b)
+    proc.balances = balances
+
+
+# ---------------------------------------------------------------------------
+# registry / slashings / final updates
+# ---------------------------------------------------------------------------
+
+
+def process_registry_updates(cfg, state, proc: EpochProcess, epoch_ctx: EpochContext) -> None:
+    epoch = proc.current_epoch
+    # eligibility + ejection
+    for i, v in enumerate(state.validators):
+        if (
+            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance == _p.MAX_EFFECTIVE_BALANCE
+        ):
+            v.activation_eligibility_epoch = epoch + 1
+        if (
+            proc.is_active_curr[i]
+            and v.effective_balance <= cfg.EJECTION_BALANCE
+        ):
+            from ..block.phase0 import initiate_validator_exit
+
+            initiate_validator_exit(cfg, state, epoch_ctx, i)
+    # dequeue activations up to churn limit
+    queue = sorted(
+        (
+            i
+            for i, v in enumerate(state.validators)
+            if v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
+            and v.activation_epoch == FAR_FUTURE_EPOCH
+        ),
+        key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
+    )
+    churn = get_validator_churn_limit(cfg, int(proc.is_active_curr.sum()))
+    for i in queue[:churn]:
+        state.validators[i].activation_epoch = compute_activation_exit_epoch(epoch)
+
+
+def process_slashings(cfg, state, proc: EpochProcess) -> None:
+    epoch = proc.current_epoch
+    total_balance = proc.total_active_balance
+    total_slashings = sum(state.slashings)
+    mult = min(
+        total_slashings * _p.PROPORTIONAL_SLASHING_MULTIPLIER, total_balance
+    )
+    for i, v in enumerate(state.validators):
+        if (
+            v.slashed
+            and epoch + _p.EPOCHS_PER_SLASHINGS_VECTOR // 2 == v.withdrawable_epoch
+        ):
+            increment = _p.EFFECTIVE_BALANCE_INCREMENT
+            penalty_numerator = v.effective_balance // increment * mult
+            penalty = penalty_numerator // total_balance * increment
+            state.balances[i] = max(0, state.balances[i] - penalty)
+
+
+def process_eth1_data_reset(cfg, state, proc: EpochProcess) -> None:
+    next_epoch = proc.current_epoch + 1
+    if next_epoch % _p.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        state.eth1_data_votes = []
+
+
+def process_effective_balance_updates(cfg, state, proc: EpochProcess) -> None:
+    increment = _p.EFFECTIVE_BALANCE_INCREMENT
+    hysteresis = increment // _p.HYSTERESIS_QUOTIENT
+    down = hysteresis * _p.HYSTERESIS_DOWNWARD_MULTIPLIER
+    up = hysteresis * _p.HYSTERESIS_UPWARD_MULTIPLIER
+    for i, v in enumerate(state.validators):
+        balance = state.balances[i]
+        if (
+            balance + down < v.effective_balance
+            or v.effective_balance + up < balance
+        ):
+            v.effective_balance = min(
+                balance - balance % increment, _p.MAX_EFFECTIVE_BALANCE
+            )
+
+
+def process_slashings_reset(cfg, state, proc: EpochProcess) -> None:
+    next_epoch = proc.current_epoch + 1
+    state.slashings[next_epoch % _p.EPOCHS_PER_SLASHINGS_VECTOR] = 0
+
+
+def process_randao_mixes_reset(cfg, state, proc: EpochProcess) -> None:
+    next_epoch = proc.current_epoch + 1
+    state.randao_mixes[next_epoch % _p.EPOCHS_PER_HISTORICAL_VECTOR] = get_randao_mix(
+        state, proc.current_epoch
+    )
+
+
+def process_historical_roots_update(cfg, state, proc: EpochProcess) -> None:
+    next_epoch = proc.current_epoch + 1
+    if (
+        next_epoch
+        % (_p.SLOTS_PER_HISTORICAL_ROOT // _p.SLOTS_PER_EPOCH)
+        == 0
+    ):
+        batch = ssz.phase0.HistoricalBatch(
+            block_roots=list(state.block_roots),
+            state_roots=list(state.state_roots),
+        )
+        state.historical_roots.append(
+            ssz.phase0.HistoricalBatch.hash_tree_root(batch)
+        )
+
+
+def process_participation_record_updates(cfg, state, proc: EpochProcess) -> None:
+    state.previous_epoch_attestations = state.current_epoch_attestations
+    state.current_epoch_attestations = []
+
+
+def process_epoch(cfg, state, epoch_ctx: EpochContext) -> EpochProcess:
+    proc = before_process_epoch(cfg, state, epoch_ctx)
+    process_justification_and_finalization(cfg, state, proc)
+    process_rewards_and_penalties(cfg, state, proc)
+    process_registry_updates(cfg, state, proc, epoch_ctx)
+    process_slashings(cfg, state, proc)
+    process_eth1_data_reset(cfg, state, proc)
+    process_effective_balance_updates(cfg, state, proc)
+    process_slashings_reset(cfg, state, proc)
+    process_randao_mixes_reset(cfg, state, proc)
+    process_historical_roots_update(cfg, state, proc)
+    process_participation_record_updates(cfg, state, proc)
+    return proc

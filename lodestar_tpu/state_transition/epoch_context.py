@@ -1,0 +1,171 @@
+"""Per-epoch flat caches: shufflings, committees, proposers, balances.
+
+The rebuild's EpochContext (reference:
+packages/state-transition/src/cache/epochContext.ts:80,
+util/epochShuffling.ts, cache/effectiveBalanceIncrements.ts): everything
+O(V) is precomputed once per epoch into numpy arrays — the representation
+both the host hot loops and future device kernels consume directly
+(SURVEY §2.4 rebuild note).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+)
+from .util.misc import (
+    compute_committee_count_per_slot,
+    compute_epoch_at_slot,
+    compute_proposer_index,
+    compute_start_slot_at_epoch,
+    get_seed,
+    int_to_bytes,
+    sha256,
+    shuffle_list,
+)
+
+
+@dataclass
+class EpochShuffling:
+    """Shuffling of one epoch's active set (util/epochShuffling.ts)."""
+
+    epoch: int
+    active_indices: np.ndarray  # all active validator indices
+    shuffling: np.ndarray       # shuffled active indices (flat)
+    committees_per_slot: int
+
+    def committee(self, slot: int, index: int) -> np.ndarray:
+        """Committee = contiguous slice of the shuffled list (spec
+        compute_committee)."""
+        slot_in_epoch = slot % _p.SLOTS_PER_EPOCH
+        committee_index = slot_in_epoch * self.committees_per_slot + index
+        count = self.committees_per_slot * _p.SLOTS_PER_EPOCH
+        n = len(self.shuffling)
+        start = n * committee_index // count
+        end = n * (committee_index + 1) // count
+        return self.shuffling[start:end]
+
+
+def compute_epoch_shuffling(state, epoch: int) -> EpochShuffling:
+    active = np.array(
+        [
+            i
+            for i, v in enumerate(state.validators)
+            if v.activation_epoch <= epoch < v.exit_epoch
+        ],
+        dtype=np.int64,
+    )
+    seed = get_seed(state, epoch, DOMAIN_BEACON_ATTESTER)
+    shuffled = shuffle_list(active, seed)
+    return EpochShuffling(
+        epoch=epoch,
+        active_indices=active,
+        shuffling=shuffled,
+        committees_per_slot=compute_committee_count_per_slot(len(active)),
+    )
+
+
+class EpochContext:
+    """Caches for the CURRENT state epoch plus previous/next shufflings,
+    rebuilt/rotated on epoch transitions."""
+
+    def __init__(self, state):
+        self.pubkey2index: Dict[bytes, int] = {
+            bytes(v.pubkey): i for i, v in enumerate(state.validators)
+        }
+        epoch = compute_epoch_at_slot(state.slot)
+        self.epoch = epoch
+        self.previous_shuffling = compute_epoch_shuffling(state, max(0, epoch - 1))
+        self.current_shuffling = compute_epoch_shuffling(state, epoch)
+        self.next_shuffling = compute_epoch_shuffling(state, epoch + 1)
+        self.effective_balance_increments = np.array(
+            [v.effective_balance // _p.EFFECTIVE_BALANCE_INCREMENT for v in state.validators],
+            dtype=np.int64,
+        )
+        self.proposers = self._compute_proposers(state, epoch)
+        # exit-queue cache (reference epochContext exitQueueEpoch/Churn),
+        # computed lazily by initiate_validator_exit, updated incrementally
+        self.exit_queue_epoch: Optional[int] = None
+        self.exit_queue_churn = 0
+        self.churn_limit = 0
+
+    def clone(self) -> "EpochContext":
+        """Copy for a forked state: immutable caches (numpy shufflings,
+        proposers) are shared; mutable per-fork state (pubkey2index, exit
+        queue) is copied."""
+        import copy as _copy
+
+        new = _copy.copy(self)
+        new.pubkey2index = dict(self.pubkey2index)
+        return new
+
+    # ------------------------------------------------------------------
+
+    def _compute_proposers(self, state, epoch: int) -> List[int]:
+        eff = self.effective_balance_increments * _p.EFFECTIVE_BALANCE_INCREMENT
+        out = []
+        active = self.current_shuffling.active_indices
+        base_seed = get_seed(state, epoch, DOMAIN_BEACON_PROPOSER)
+        for slot in range(
+            compute_start_slot_at_epoch(epoch),
+            compute_start_slot_at_epoch(epoch + 1),
+        ):
+            seed = sha256(base_seed + int_to_bytes(slot, 8))
+            out.append(compute_proposer_index(eff, active, seed))
+        return out
+
+    def get_beacon_proposer(self, slot: int) -> int:
+        epoch = compute_epoch_at_slot(slot)
+        assert epoch == self.epoch, f"proposer requested for epoch {epoch} != {self.epoch}"
+        return self.proposers[slot % _p.SLOTS_PER_EPOCH]
+
+    def get_shuffling(self, epoch: int) -> EpochShuffling:
+        if epoch == self.epoch:
+            return self.current_shuffling
+        if epoch == self.epoch - 1:
+            return self.previous_shuffling
+        if epoch == self.epoch + 1:
+            return self.next_shuffling
+        raise ValueError(f"no shuffling cached for epoch {epoch} (at {self.epoch})")
+
+    def get_committee(self, slot: int, index: int) -> np.ndarray:
+        return self.get_shuffling(compute_epoch_at_slot(slot)).committee(slot, index)
+
+    def get_committee_count_per_slot(self, epoch: int) -> int:
+        return self.get_shuffling(epoch).committees_per_slot
+
+    def total_active_balance_increments(self, epoch: Optional[int] = None) -> int:
+        sh = self.get_shuffling(self.epoch if epoch is None else epoch)
+        if len(sh.active_indices) == 0:
+            return 1
+        return max(1, int(self.effective_balance_increments[sh.active_indices].sum()))
+
+    # epoch rollover ---------------------------------------------------
+
+    def rotate(self, state) -> None:
+        """After an epoch transition: shift shufflings and rebuild the
+        epoch-scoped caches (epochContext.ts afterProcessEpoch)."""
+        new_epoch = compute_epoch_at_slot(state.slot)
+        assert new_epoch == self.epoch + 1
+        self.previous_shuffling = self.current_shuffling
+        self.current_shuffling = self.next_shuffling
+        self.next_shuffling = compute_epoch_shuffling(state, new_epoch + 1)
+        self.epoch = new_epoch
+        self.effective_balance_increments = np.array(
+            [v.effective_balance // _p.EFFECTIVE_BALANCE_INCREMENT for v in state.validators],
+            dtype=np.int64,
+        )
+        self.proposers = self._compute_proposers(state, new_epoch)
+        self.exit_queue_epoch = None  # recompute lazily for the new epoch
+        self.exit_queue_churn = 0
+        self.churn_limit = 0
+        for i, v in enumerate(state.validators):
+            pk = bytes(v.pubkey)
+            if pk not in self.pubkey2index:
+                self.pubkey2index[pk] = i

@@ -1,0 +1,133 @@
+"""Extract every signature set a block carries (reference:
+packages/state-transition/src/signatureSets/index.ts:26
+getBlockSignatureSets).  These sets feed the device BLS verifier in
+parallel with the state transition (verifyBlock.ts:71-80).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_VOLUNTARY_EXIT,
+)
+from lodestar_tpu.types import ssz
+from .block.phase0 import get_domain, get_indexed_attestation
+from .epoch_context import EpochContext
+from .util.domain import compute_signing_root
+from .util.misc import compute_epoch_at_slot
+
+
+def _pk(state, index: int) -> bls.PublicKey:
+    return bls.PublicKey.from_bytes(bytes(state.validators[index].pubkey))
+
+
+def get_block_proposer_signature_set(cfg, state, epoch_ctx, signed_block) -> bls.SignatureSet:
+    block = signed_block.message
+    domain = get_domain(
+        cfg, state, DOMAIN_BEACON_PROPOSER, compute_epoch_at_slot(block.slot)
+    )
+    block_t = type(block)
+    root = compute_signing_root(block_t, block, domain)
+    return bls.SignatureSet(
+        public_key=_pk(state, block.proposer_index),
+        message=root,
+        signature=bls.Signature.from_bytes(bytes(signed_block.signature)),
+    )
+
+
+def get_randao_signature_set(cfg, state, epoch_ctx, block) -> bls.SignatureSet:
+    epoch = compute_epoch_at_slot(block.slot)
+    domain = get_domain(cfg, state, DOMAIN_RANDAO, epoch)
+    root = compute_signing_root(ssz.phase0.Epoch, epoch, domain)
+    return bls.SignatureSet(
+        public_key=_pk(state, block.proposer_index),
+        message=root,
+        signature=bls.Signature.from_bytes(bytes(block.body.randao_reveal)),
+    )
+
+
+def get_indexed_attestation_signature_set(cfg, state, indexed) -> bls.SignatureSet:
+    domain = get_domain(cfg, state, DOMAIN_BEACON_ATTESTER, indexed.data.target.epoch)
+    root = compute_signing_root(ssz.phase0.AttestationData, indexed.data, domain)
+    pks = [_pk(state, i) for i in indexed.attesting_indices]
+    return bls.SignatureSet(
+        public_key=bls.aggregate_public_keys(pks),
+        message=root,
+        signature=bls.Signature.from_bytes(bytes(indexed.signature)),
+    )
+
+
+def get_attestations_signature_sets(cfg, state, epoch_ctx, block) -> List[bls.SignatureSet]:
+    return [
+        get_indexed_attestation_signature_set(
+            cfg, state, get_indexed_attestation(epoch_ctx, att)
+        )
+        for att in block.body.attestations
+    ]
+
+
+def get_voluntary_exit_signature_set(cfg, state, signed_exit) -> bls.SignatureSet:
+    domain = get_domain(cfg, state, DOMAIN_VOLUNTARY_EXIT, signed_exit.message.epoch)
+    root = compute_signing_root(ssz.phase0.VoluntaryExit, signed_exit.message, domain)
+    return bls.SignatureSet(
+        public_key=_pk(state, signed_exit.message.validator_index),
+        message=root,
+        signature=bls.Signature.from_bytes(bytes(signed_exit.signature)),
+    )
+
+
+def get_proposer_slashing_signature_sets(cfg, state, ps) -> List[bls.SignatureSet]:
+    out = []
+    for signed in (ps.signed_header_1, ps.signed_header_2):
+        domain = get_domain(
+            cfg, state, DOMAIN_BEACON_PROPOSER, compute_epoch_at_slot(signed.message.slot)
+        )
+        root = compute_signing_root(ssz.phase0.BeaconBlockHeader, signed.message, domain)
+        out.append(
+            bls.SignatureSet(
+                public_key=_pk(state, signed.message.proposer_index),
+                message=root,
+                signature=bls.Signature.from_bytes(bytes(signed.signature)),
+            )
+        )
+    return out
+
+
+def get_attester_slashing_signature_sets(cfg, state, asl) -> List[bls.SignatureSet]:
+    return [
+        get_indexed_attestation_signature_set(cfg, state, a)
+        for a in (asl.attestation_1, asl.attestation_2)
+    ]
+
+
+def get_block_signature_sets(
+    cfg,
+    state,
+    epoch_ctx: EpochContext,
+    signed_block,
+    skip_proposer_signature: bool = False,
+) -> List[bls.SignatureSet]:
+    """All sets in a block: proposer, randao, ops (~100+ per mainnet block
+    — the load the device batch verifier is built for)."""
+    block = signed_block.message
+    sets: List[bls.SignatureSet] = []
+    if not skip_proposer_signature:
+        sets.append(
+            get_block_proposer_signature_set(cfg, state, epoch_ctx, signed_block)
+        )
+    sets.append(get_randao_signature_set(cfg, state, epoch_ctx, block))
+    for ps in block.body.proposer_slashings:
+        sets.extend(get_proposer_slashing_signature_sets(cfg, state, ps))
+    for asl in block.body.attester_slashings:
+        sets.extend(get_attester_slashing_signature_sets(cfg, state, asl))
+    sets.extend(get_attestations_signature_sets(cfg, state, epoch_ctx, block))
+    for ex in block.body.voluntary_exits:
+        sets.append(get_voluntary_exit_signature_set(cfg, state, ex))
+    # deposits carry their own proof-of-possession checked inline
+    # (processDeposit) because the pubkey may be brand new — same as the
+    # reference (signatureSets/index.ts comment).
+    return sets

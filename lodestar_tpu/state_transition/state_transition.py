@@ -1,0 +1,98 @@
+"""The state transition function (reference:
+packages/state-transition/src/stateTransition.ts:42).
+
+process_slots advances through empty slots (epoch processing at
+boundaries), process_block applies a block, state_transition does both plus
+the optional post-state root check.  Signature verification is decoupled:
+callers run the BLS sets through the device verifier in parallel
+(chain/blocks/verifyBlock.ts:71-80 pattern).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+from lodestar_tpu.types import ssz
+from .block import phase0 as block_phase0
+from .epoch import phase0 as epoch_phase0
+from .epoch_context import EpochContext
+from .util.misc import compute_epoch_at_slot
+
+
+class CachedBeaconState:
+    """State + epoch caches travelling together (the reference's
+    CachedBeaconState, cache/stateCache.ts:127 — here a thin pair since the
+    flat caches live in EpochContext)."""
+
+    def __init__(self, cfg, state, epoch_ctx: Optional[EpochContext] = None):
+        self.cfg = cfg
+        self.state = state
+        self.epoch_ctx = epoch_ctx or EpochContext(state)
+
+    def clone(self) -> "CachedBeaconState":
+        new = CachedBeaconState.__new__(CachedBeaconState)
+        new.cfg = self.cfg
+        new.state = self.state.copy()
+        new.epoch_ctx = self.epoch_ctx.clone()
+        return new
+
+    def hash_tree_root(self) -> bytes:
+        return ssz.phase0.BeaconState.hash_tree_root(self.state)
+
+
+def process_slot(cfg, state) -> None:
+    """Cache state/block roots for the slot about to end."""
+    prev_state_root = ssz.phase0.BeaconState.hash_tree_root(state)
+    state.state_roots[state.slot % _p.SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
+    if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
+        state.latest_block_header.state_root = prev_state_root
+    block_root = ssz.phase0.BeaconBlockHeader.hash_tree_root(
+        state.latest_block_header
+    )
+    state.block_roots[state.slot % _p.SLOTS_PER_HISTORICAL_ROOT] = block_root
+
+
+def process_slots(cached: CachedBeaconState, slot: int) -> None:
+    state = cached.state
+    if state.slot >= slot:
+        raise ValueError(f"cannot advance state from {state.slot} to {slot}")
+    while state.slot < slot:
+        process_slot(cached.cfg, state)
+        if (state.slot + 1) % _p.SLOTS_PER_EPOCH == 0:
+            epoch_phase0.process_epoch(cached.cfg, state, cached.epoch_ctx)
+            state.slot += 1
+            cached.epoch_ctx.rotate(state)
+        else:
+            state.slot += 1
+
+
+def state_transition(
+    cached: CachedBeaconState,
+    signed_block,
+    verify_state_root: bool = True,
+    verify_proposer: bool = True,
+    verify_signatures: bool = True,
+) -> CachedBeaconState:
+    """Full STF on a CLONE of the input state; returns the post state."""
+    post = cached.clone()
+    block = signed_block.message
+    if post.state.slot < block.slot:
+        process_slots(post, block.slot)
+    if verify_proposer:
+        from .signature_sets import get_block_proposer_signature_set
+        from lodestar_tpu.crypto.bls.api import verify_signature_set
+
+        if not verify_signature_set(
+            get_block_proposer_signature_set(post.cfg, post.state, post.epoch_ctx, signed_block)
+        ):
+            raise ValueError("invalid block signature")
+    block_phase0.process_block(
+        post.cfg, post.state, post.epoch_ctx, block, verify_signatures
+    )
+    if verify_state_root:
+        root = post.hash_tree_root()
+        if bytes(block.state_root) != root:
+            raise ValueError(
+                f"state root mismatch: block {bytes(block.state_root).hex()} != {root.hex()}"
+            )
+    return post

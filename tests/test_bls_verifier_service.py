@@ -1,0 +1,154 @@
+"""Verifier-service tests: batching window, job packing, invalid fallback.
+
+Uses a fake device backend so the service logic is tested without paying
+device-kernel compiles (the kernels themselves are covered by
+tests/test_pairing_jax.py).  Mirrors the semantics the reference's pool
+tests cover for multithread/index.ts.
+"""
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls import (
+    DeviceBlsVerifier,
+    MAX_BUFFERED_SIGS,
+    SingleThreadBlsVerifier,
+    VerifyOptions,
+)
+from lodestar_tpu.crypto.bls.api import SecretKey, SignatureSet
+
+
+class FakeBackend:
+    """Oracle-checked fake of ops.bls12_381.verify's host entry points."""
+
+    def __init__(self):
+        self.batch_calls = []
+        self.each_calls = []
+
+    def verify_signature_sets_device(self, sets):
+        from lodestar_tpu.crypto.bls.api import verify_signature_set
+
+        self.batch_calls.append(len(sets))
+        return all(verify_signature_set(s) for s in sets)
+
+    def verify_each_device(self, sets):
+        from lodestar_tpu.crypto.bls.api import verify_signature_set
+
+        self.each_calls.append(len(sets))
+        return [verify_signature_set(s) for s in sets]
+
+
+def make_sets(n, valid=True):
+    out = []
+    for i in range(n):
+        sk = SecretKey.from_bytes(bytes([0] * 30 + [2, i + 1]))
+        msg = bytes([i]) * 32
+        sig = sk.sign(msg if valid else b"\xee" * 32)
+        out.append(SignatureSet(sk.to_public_key(), msg, sig))
+    return out
+
+
+@pytest.fixture()
+def pool():
+    return DeviceBlsVerifier(_backend=FakeBackend())
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+class TestDevicePool:
+    def test_non_batchable_dispatches_immediately(self, pool):
+        async def go():
+            return await pool.verify_signature_sets(make_sets(3))
+
+        assert run(go()) is True
+        assert pool._dv.batch_calls == [3]
+
+    def test_batchable_requests_coalesce_into_one_job(self, pool):
+        async def go():
+            opts = VerifyOptions(batchable=True)
+            r = await asyncio.gather(
+                *(pool.verify_signature_sets(make_sets(1), opts) for _ in range(5))
+            )
+            return r
+
+        assert run(go()) == [True] * 5
+        # all 5 single-set requests coalesced (flush happened once, 5 sets)
+        assert pool._dv.batch_calls == [5]
+
+    def test_window_flushes_at_max_buffered_sigs(self, pool):
+        async def go():
+            opts = VerifyOptions(batchable=True)
+            n = MAX_BUFFERED_SIGS
+            return await asyncio.gather(
+                *(pool.verify_signature_sets(make_sets(1), opts) for _ in range(n))
+            )
+
+        res = run(go())
+        assert all(res)
+        assert sum(pool._dv.batch_calls) == MAX_BUFFERED_SIGS
+
+    def test_invalid_set_triggers_per_set_fallback(self, pool):
+        async def go():
+            opts = VerifyOptions(batchable=True)
+            good = pool.verify_signature_sets(make_sets(2), opts)
+            bad = pool.verify_signature_sets(make_sets(1, valid=False), opts)
+            return await asyncio.gather(good, bad)
+
+        res = run(go())
+        assert res == [True, False]
+        assert pool._dv.each_calls, "fallback per-set pass did not run"
+
+    def test_oversized_request_chunks(self, pool):
+        async def go():
+            return await pool.verify_signature_sets(
+                make_sets(130), VerifyOptions(batchable=True)
+            )
+
+        assert run(go()) is True
+        assert pool._dv.batch_calls == [128, 2]
+
+    def test_verify_on_main_thread(self, pool):
+        async def go():
+            return await pool.verify_signature_sets(
+                make_sets(1), VerifyOptions(verify_on_main_thread=True)
+            )
+
+        assert run(go()) is True
+        assert pool._dv.batch_calls == []
+
+    def test_close_rejects_pending(self, pool):
+        async def go():
+            opts = VerifyOptions(batchable=True)
+            fut = asyncio.ensure_future(
+                pool.verify_signature_sets(make_sets(1), opts)
+            )
+            await asyncio.sleep(0)  # let it buffer
+            await pool.close()
+            with pytest.raises(RuntimeError):
+                await fut
+
+        run(go())
+
+    def test_empty_input_false(self, pool):
+        async def go():
+            return await pool.verify_signature_sets([])
+
+        assert run(go()) is False
+
+
+class TestSingleThreadVerifier:
+    def test_valid_and_invalid(self):
+        v = SingleThreadBlsVerifier()
+
+        async def go():
+            ok = await v.verify_signature_sets(make_sets(2))
+            bad = await v.verify_signature_sets(
+                make_sets(1) + make_sets(1, valid=False)
+            )
+            return ok, bad
+
+        ok, bad = run(go())
+        assert ok is True
+        assert bad is False
