@@ -1,25 +1,117 @@
 """lodestar-tpu CLI entry point.
 
-Mirrors the reference's command set (cli/src/cmds: beacon, validator,
-lightclient, dev); commands are registered as subsystems land.
+Mirrors the reference's command set (packages/cli/src/cmds: beacon,
+validator, lightclient, dev).  The `dev` command runs the in-process
+single-node dev chain (reference cmds/dev/: interop validators producing
+and importing blocks), with BLS verification on the host oracle or the
+device verifier.
+
+NOTE: the preset is chosen by the LODESTAR_TPU_PRESET env var at import
+time (like the reference's LODESTAR_PRESET compile-time switch); `dev`
+defaults to minimal via the wrapper in __main__.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+import time
 
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
-        prog="lodestar",
+        prog="lodestar-tpu",
         description="TPU-native Ethereum consensus client",
     )
     sub = parser.add_subparsers(dest="command")
     sub.add_parser("version", help="print version and exit")
+
+    dev = sub.add_parser(
+        "dev", help="run a single-node interop dev chain in-process"
+    )
+    dev.add_argument("--validators", type=int, default=8, help="interop validator count")
+    dev.add_argument("--slots", type=int, default=None, help="stop after N slots (default: run forever)")
+    dev.add_argument(
+        "--verifier",
+        choices=["none", "oracle", "device"],
+        default="oracle",
+        help="BLS verification backend for block import",
+    )
+    dev.add_argument(
+        "--realtime",
+        action="store_true",
+        help="tick wall-clock slots (SECONDS_PER_SLOT) instead of running flat out",
+    )
+    dev.add_argument("--genesis-time", type=int, default=None)
     return parser
 
 
-def main(argv: list[str] | None = None) -> int:
+def run_dev(args) -> int:
+    from lodestar_tpu.chain.dev import DevChain
+    from lodestar_tpu.config import default_chain_config as cfg
+    from lodestar_tpu.params import ACTIVE_PRESET_NAME, SLOTS_PER_EPOCH
+    from lodestar_tpu.types import ssz
+
+    genesis_time = args.genesis_time if args.genesis_time is not None else int(time.time())
+    print(
+        f"dev chain: preset={ACTIVE_PRESET_NAME} validators={args.validators} "
+        f"verifier={args.verifier}",
+        flush=True,
+    )
+    chain = DevChain(cfg, args.validators, genesis_time=genesis_time)
+    print(
+        f"genesis state root {chain.head.hash_tree_root().hex()} "
+        f"(slots/epoch={SLOTS_PER_EPOCH})",
+        flush=True,
+    )
+
+    verifier = None
+    verify = args.verifier != "none"
+    if args.verifier == "device":
+        from lodestar_tpu.chain.bls import DeviceBlsVerifier
+
+        verifier = DeviceBlsVerifier()
+
+    slot = 0
+    try:
+        while args.slots is None or slot < args.slots:
+            slot += 1
+            if args.realtime:
+                target = genesis_time + slot * cfg.SECONDS_PER_SLOT
+                while time.time() < target:
+                    time.sleep(min(0.25, max(0.0, target - time.time())))
+            t0 = time.time()
+            imported = chain.run_slot(slot, verifier, verify_signatures=verify)
+            st = chain.head.state
+            print(
+                json.dumps(
+                    {
+                        "slot": slot,
+                        "root": imported.root.hex()[:16],
+                        "attestations": len(
+                            imported.block.message.body.attestations
+                        ),
+                        "justified": st.current_justified_checkpoint.epoch,
+                        "finalized": st.finalized_checkpoint.epoch,
+                        "verified_sets": chain.verified_set_count,
+                        "ms": round((time.time() - t0) * 1e3),
+                    }
+                ),
+                flush=True,
+            )
+    except KeyboardInterrupt:
+        pass
+    st = chain.head.state
+    print(
+        f"stopped at slot {st.slot}: justified={st.current_justified_checkpoint.epoch} "
+        f"finalized={st.finalized_checkpoint.epoch} "
+        f"verified_sets={chain.verified_set_count}",
+        flush=True,
+    )
+    return 0
+
+
+def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.command == "version":
@@ -28,8 +120,10 @@ def main(argv: list[str] | None = None) -> int:
         try:
             print(version("lodestar-tpu"))
         except PackageNotFoundError:
-            print("0.1.0 (uninstalled tree)")
+            print("0.2.0 (uninstalled tree)")
         return 0
+    if args.command == "dev":
+        return run_dev(args)
     parser.print_help()
     return 1
 
