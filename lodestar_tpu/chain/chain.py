@@ -1,0 +1,385 @@
+"""BeaconChain: the node's central composition (reference:
+packages/beacon-node/src/chain/chain.ts:75 BeaconChain).
+
+Wires the clock, fork choice, state caches/regen, op pools, seen caches,
+the pluggable BLS verifier, the execution engine, and the block pipeline:
+
+  process_block -> bounded queue -> verify (payload ∥ STF ∥ signatures,
+  asyncio.gather mirroring verifyBlock.ts:71-80) -> import (db + fork
+  choice + head update + pruning + events)
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from enum import Enum
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.params import ACTIVE_PRESET as _p, INTERVALS_PER_SLOT
+from lodestar_tpu.state_transition import CachedBeaconState, state_transition
+from lodestar_tpu.state_transition.epoch.phase0 import (
+    before_process_epoch,
+    weigh_justification_and_finalization,
+)
+from lodestar_tpu.state_transition.signature_sets import get_block_signature_sets
+from lodestar_tpu.types import ssz
+from lodestar_tpu.utils.queue import JobItemQueue, QueueType
+from .bls import BlsVerifier, SingleThreadBlsVerifier, VerifyOptions
+from .clock import LocalClock
+from .op_pools import AggregatedAttestationPool, AttestationPool, OpPool
+from .regen import CheckpointStateCache, StateContextCache, StateRegenerator
+from .seen_cache import (
+    SeenAggregatedAttestations,
+    SeenAttesters,
+    SeenBlockProposers,
+)
+from lodestar_tpu.fork_choice import (
+    CheckpointHex,
+    ExecutionStatus,
+    ForkChoice,
+    ForkChoiceStore,
+    ProtoArray,
+    ProtoBlock,
+)
+
+BLOCK_QUEUE_LENGTH = 256  # blocks/index.ts:17
+
+
+class ChainEvent(str, Enum):
+    block = "block"
+    head = "head"
+    justified = "justified"
+    finalized = "finalized"
+    checkpoint = "checkpoint"
+
+
+def _hex(root: bytes) -> str:
+    return "0x" + root.hex()
+
+
+def compute_unrealized_checkpoints(cfg, cached: CachedBeaconState):
+    """What justification/finalization WOULD be if the epoch ended now
+    (reference computeUnrealizedCheckpoints, used for fork-choice
+    viability).  Runs the flag sweep + a non-mutating weigh pass."""
+    state = cached.state
+    proc = before_process_epoch(cfg, state, cached.epoch_ctx)
+    if proc.current_epoch <= 1:
+        return state.current_justified_checkpoint, state.finalized_checkpoint
+
+    class _Shadow:
+        __slots__ = (
+            "slot", "previous_justified_checkpoint", "current_justified_checkpoint",
+            "finalized_checkpoint", "justification_bits", "block_roots",
+        )
+
+    sh = _Shadow()
+    sh.slot = state.slot
+    sh.previous_justified_checkpoint = state.previous_justified_checkpoint
+    sh.current_justified_checkpoint = state.current_justified_checkpoint
+    sh.finalized_checkpoint = state.finalized_checkpoint
+    sh.justification_bits = list(state.justification_bits)
+    sh.block_roots = state.block_roots
+    from lodestar_tpu.state_transition.epoch.phase0 import (
+        FLAG_CURR_TARGET,
+        FLAG_PREV_TARGET,
+        _unslashed_attesting_balance,
+    )
+
+    weigh_justification_and_finalization(
+        cfg,
+        sh,
+        proc.total_active_balance,
+        _unslashed_attesting_balance(proc, FLAG_PREV_TARGET),
+        _unslashed_attesting_balance(proc, FLAG_CURR_TARGET),
+    )
+    return sh.current_justified_checkpoint, sh.finalized_checkpoint
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        cfg,
+        db: BeaconDb,
+        anchor_state,
+        verifier: Optional[BlsVerifier] = None,
+        execution_engine=None,
+        clock: Optional[LocalClock] = None,
+    ):
+        self.cfg = cfg
+        self.db = db
+        self.bls = verifier or SingleThreadBlsVerifier()
+        self.execution_engine = execution_engine
+        anchor = CachedBeaconState(cfg, anchor_state)
+        self.genesis_time = anchor_state.genesis_time
+        self.genesis_validators_root = bytes(anchor_state.genesis_validators_root)
+        self.clock = clock or LocalClock(self.genesis_time, cfg.SECONDS_PER_SLOT)
+
+        # anchor block (genesis or checkpoint block header)
+        hdr = anchor_state.latest_block_header
+        anchor_hdr = ssz.phase0.BeaconBlockHeader(
+            slot=hdr.slot, proposer_index=hdr.proposer_index,
+            parent_root=hdr.parent_root, state_root=hdr.state_root,
+            body_root=hdr.body_root,
+        )
+        if bytes(anchor_hdr.state_root) == b"\x00" * 32:
+            anchor_hdr.state_root = anchor.hash_tree_root()
+        anchor_root = ssz.phase0.BeaconBlockHeader.hash_tree_root(anchor_hdr)
+        self.anchor_root = anchor_root
+
+        # caches + regen
+        self.state_cache = StateContextCache()
+        self.checkpoint_state_cache = CheckpointStateCache()
+        self.state_cache.add(anchor_root, anchor)
+        self.regen = StateRegenerator(self.state_cache, self.db.block.get)
+
+        # fork choice
+        fin = anchor_state.finalized_checkpoint
+        just = anchor_state.current_justified_checkpoint
+        anchor_epoch = anchor_state.slot // _p.SLOTS_PER_EPOCH
+        anchor_cp = CheckpointHex(max(just.epoch, anchor_epoch), _hex(anchor_root))
+        balances = list(anchor.epoch_ctx.effective_balance_increments)
+        proto = ProtoArray.initialize(
+            ProtoBlock(
+                slot=anchor_state.slot,
+                block_root=_hex(anchor_root),
+                parent_root=_hex(bytes(hdr.parent_root)),
+                state_root=_hex(bytes(anchor_hdr.state_root)),
+                target_root=_hex(anchor_root),
+                justified_epoch=anchor_cp.epoch,
+                justified_root=anchor_cp.root,
+                finalized_epoch=anchor_cp.epoch,
+                finalized_root=anchor_cp.root,
+                unrealized_justified_epoch=anchor_cp.epoch,
+                unrealized_justified_root=anchor_cp.root,
+                unrealized_finalized_epoch=anchor_cp.epoch,
+                unrealized_finalized_root=anchor_cp.root,
+                execution_status=ExecutionStatus.PreMerge,
+            ),
+            current_slot=max(anchor_state.slot, self.clock.current_slot),
+        )
+        store = ForkChoiceStore(
+            current_slot=max(anchor_state.slot, self.clock.current_slot),
+            justified=anchor_cp,
+            justified_balances=balances,
+            finalized=anchor_cp,
+            unrealized_justified=anchor_cp,
+            unrealized_finalized=anchor_cp,
+        )
+        self.fork_choice = ForkChoice(cfg, store, proto)
+
+        # pools + dedup caches
+        self.attestation_pool = AttestationPool()
+        self.aggregated_attestation_pool = AggregatedAttestationPool()
+        self.op_pool = OpPool()
+        self.seen_attesters = SeenAttesters()
+        self.seen_aggregators = SeenAttesters()
+        self.seen_aggregated_attestations = SeenAggregatedAttestations()
+        self.seen_block_proposers = SeenBlockProposers()
+
+        # block pipeline
+        self.block_queue: JobItemQueue = JobItemQueue(
+            self._process_block_job,
+            max_length=BLOCK_QUEUE_LENGTH,
+            queue_type=QueueType.FIFO,
+            max_concurrency=1,
+            name="block-processor",
+        )
+        self._event_handlers: Dict[ChainEvent, List[Callable]] = {}
+        self.head_root: bytes = anchor_root
+        self.db.block.put(anchor_root, _genesis_signed_block(anchor_hdr))
+
+    # ------------------------------------------------------------------
+    # events
+    # ------------------------------------------------------------------
+
+    def on(self, event: ChainEvent, handler: Callable) -> None:
+        self._event_handlers.setdefault(event, []).append(handler)
+
+    def _emit(self, event: ChainEvent, *args) -> None:
+        for h in self._event_handlers.get(event, []):
+            h(*args)
+
+    # ------------------------------------------------------------------
+    # block pipeline
+    # ------------------------------------------------------------------
+
+    async def process_block(self, signed_block) -> bytes:
+        """Queue a gossip/sync block for verification + import; resolves
+        with the block root (chain.ts processBlock -> BlockProcessor)."""
+        return await self.block_queue.push(signed_block)
+
+    async def _process_block_job(self, signed_block) -> bytes:
+        block = signed_block.message
+        root = ssz.phase0.BeaconBlock.hash_tree_root(block)
+
+        # sanity checks (verifyBlocksSanityChecks.ts)
+        if self.db.block.has(root):
+            return root  # already known
+        current_slot = max(self.clock.current_slot, self.fork_choice.store.current_slot)
+        if block.slot > current_slot:
+            raise ValueError(f"future block slot {block.slot} > {current_slot}")
+        fin = self.fork_choice.store.finalized
+        if block.slot <= fin.epoch * _p.SLOTS_PER_EPOCH:
+            raise ValueError("block older than finalized checkpoint")
+        parent_root = bytes(block.parent_root)
+        if not self.fork_choice.has_block(_hex(parent_root)):
+            raise ValueError(f"unknown parent {parent_root.hex()}")
+
+        pre_state = self.regen.get_pre_state(parent_root, block.slot)
+        received_at = time.time()
+
+        # 3-way parallel verify (verifyBlock.ts:71-80): execution payload ∥
+        # state transition ∥ signature sets
+        loop = asyncio.get_running_loop()
+
+        async def verify_payload():
+            if self.execution_engine is None:
+                return None
+            payload = getattr(block.body, "execution_payload", None)
+            if payload is None:
+                return None
+            return await self.execution_engine.notify_new_payload(payload)
+
+        def run_stf():
+            return state_transition(
+                pre_state, signed_block,
+                verify_state_root=True, verify_proposer=False,
+                verify_signatures=False,
+            )
+
+        async def verify_signatures():
+            sets = get_block_signature_sets(
+                self.cfg, pre_state.state, pre_state.epoch_ctx, signed_block
+            )
+            if not sets:
+                return True
+            return await self.bls.verify_signature_sets(
+                sets, VerifyOptions(batchable=True)
+            )
+
+        payload_res, post_state, sigs_ok = await asyncio.gather(
+            verify_payload(),
+            loop.run_in_executor(None, run_stf),
+            verify_signatures(),
+        )
+        if payload_res is not None and payload_res.status.value == "INVALID":
+            raise ValueError("execution payload invalid")
+        if not sigs_ok:
+            raise ValueError("block signatures invalid")
+
+        self._import_block(signed_block, root, post_state, received_at)
+        return root
+
+    def _import_block(self, signed_block, root, post_state, received_at) -> None:
+        """importBlock.ts:46: persist, fork-choice, caches, events."""
+        block = signed_block.message
+        self.db.block.put(root, signed_block)
+        self.state_cache.add(root, post_state)
+
+        st = post_state.state
+        epoch = block.slot // _p.SLOTS_PER_EPOCH
+        target_root = (
+            root
+            if block.slot % _p.SLOTS_PER_EPOCH == 0
+            else bytes(st.block_roots[(epoch * _p.SLOTS_PER_EPOCH) % _p.SLOTS_PER_HISTORICAL_ROOT])
+        )
+        uj, uf = compute_unrealized_checkpoints(self.cfg, post_state)
+        block_delay = max(
+            0.0,
+            received_at - (self.genesis_time + block.slot * self.cfg.SECONDS_PER_SLOT),
+        )
+        # capture BEFORE update_time: the epoch-boundary pull-up inside it
+        # can itself advance justification/finalization
+        old_fin = self.fork_choice.store.finalized.epoch
+        old_just = self.fork_choice.store.justified.epoch
+        self.fork_choice.update_time(
+            max(self.clock.current_slot, block.slot)
+        )
+        self.fork_choice.on_block(
+            ProtoBlock(
+                slot=block.slot,
+                block_root=_hex(root),
+                parent_root=_hex(bytes(block.parent_root)),
+                state_root=_hex(bytes(block.state_root)),
+                target_root=_hex(target_root),
+                justified_epoch=st.current_justified_checkpoint.epoch,
+                justified_root=_hex(bytes(st.current_justified_checkpoint.root)),
+                finalized_epoch=st.finalized_checkpoint.epoch,
+                finalized_root=_hex(bytes(st.finalized_checkpoint.root)),
+                unrealized_justified_epoch=uj.epoch,
+                unrealized_justified_root=_hex(bytes(uj.root)),
+                unrealized_finalized_epoch=uf.epoch,
+                unrealized_finalized_root=_hex(bytes(uf.root)),
+                execution_status=ExecutionStatus.PreMerge,
+            ),
+            block_delay_sec=block_delay,
+            justified_checkpoint=CheckpointHex(
+                st.current_justified_checkpoint.epoch,
+                _hex(bytes(st.current_justified_checkpoint.root)),
+            ),
+            finalized_checkpoint=CheckpointHex(
+                st.finalized_checkpoint.epoch,
+                _hex(bytes(st.finalized_checkpoint.root)),
+            ),
+            justified_balances=list(post_state.epoch_ctx.effective_balance_increments),
+        )
+        # register the block's attestations as LMD votes
+        for att in block.body.attestations:
+            try:
+                from lodestar_tpu.state_transition.block.phase0 import (
+                    get_attesting_indices,
+                )
+
+                indices = get_attesting_indices(
+                    post_state.epoch_ctx, att.data, att.aggregation_bits
+                )
+                self.fork_choice.on_attestation(
+                    indices,
+                    _hex(bytes(att.data.beacon_block_root)),
+                    att.data.target.epoch,
+                )
+            except Exception:
+                continue  # vote outside cached shufflings — skip
+
+        head = self.fork_choice.update_head()
+        self.head_root = bytes.fromhex(head.block_root[2:])
+        self.seen_block_proposers.add(block.slot, block.proposer_index)
+
+        self._emit(ChainEvent.block, signed_block, root)
+        self._emit(ChainEvent.head, self.head_root)
+        store = self.fork_choice.store
+        if store.justified.epoch > old_just:
+            self._emit(ChainEvent.justified, store.justified)
+        if store.finalized.epoch > old_fin:
+            self._emit(ChainEvent.finalized, store.finalized)
+            fin_epoch = store.finalized.epoch
+            self.seen_attesters.prune(fin_epoch)
+            self.seen_aggregators.prune(fin_epoch)
+            self.seen_aggregated_attestations.prune(fin_epoch)
+            self.attestation_pool.prune(self.clock.current_slot)
+            self.aggregated_attestation_pool.prune(self.clock.current_slot)
+
+    # ------------------------------------------------------------------
+
+    def get_head_state(self) -> CachedBeaconState:
+        st = self.state_cache.get(self.head_root)
+        if st is None:
+            st = self.regen.get_pre_state(self.head_root, 0)
+        return st
+
+    async def close(self) -> None:
+        self.block_queue.abort()
+        await self.bls.close()
+
+
+def _genesis_signed_block(anchor_hdr) -> "ssz.phase0.SignedBeaconBlock":
+    """Placeholder stored block for the anchor root so regen can stop
+    there; body is empty (the anchor state itself is the source of truth)."""
+    b = ssz.phase0.SignedBeaconBlock.default()
+    b.message.slot = anchor_hdr.slot
+    b.message.proposer_index = anchor_hdr.proposer_index
+    b.message.parent_root = bytes(anchor_hdr.parent_root)
+    b.message.state_root = bytes(anchor_hdr.state_root)
+    return b

@@ -1,0 +1,68 @@
+"""Slot clock (reference:
+packages/beacon-node/src/chain/clock/LocalClock.ts:14 — slot ticker off
+genesis time with epoch/slot events).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable, List, Optional
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+
+class LocalClock:
+    def __init__(self, genesis_time: int, seconds_per_slot: int, now: Callable[[], float] = time.time):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+        self._now = now
+        self._on_slot: List[Callable[[int], Awaitable[None]]] = []
+        self._on_epoch: List[Callable[[int], Awaitable[None]]] = []
+        self._task: Optional[asyncio.Task] = None
+
+    @property
+    def current_slot(self) -> int:
+        return max(0, int((self._now() - self.genesis_time) // self.seconds_per_slot))
+
+    @property
+    def current_epoch(self) -> int:
+        return self.current_slot // _p.SLOTS_PER_EPOCH
+
+    def slot_start_time(self, slot: int) -> float:
+        return self.genesis_time + slot * self.seconds_per_slot
+
+    def seconds_into_slot(self) -> float:
+        return (self._now() - self.genesis_time) % self.seconds_per_slot
+
+    def on_slot(self, cb: Callable[[int], Awaitable[None]]) -> None:
+        self._on_slot.append(cb)
+
+    def on_epoch(self, cb: Callable[[int], Awaitable[None]]) -> None:
+        self._on_epoch.append(cb)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._tick_loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _tick_loop(self) -> None:
+        last_slot = self.current_slot
+        while True:
+            next_slot = last_slot + 1
+            wait = self.slot_start_time(next_slot) - self._now()
+            if wait > 0:
+                await asyncio.sleep(wait)
+            last_slot = next_slot
+            for cb in self._on_slot:
+                await cb(next_slot)
+            if next_slot % _p.SLOTS_PER_EPOCH == 0:
+                for cb in self._on_epoch:
+                    await cb(next_slot // _p.SLOTS_PER_EPOCH)

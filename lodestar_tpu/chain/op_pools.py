@@ -1,0 +1,164 @@
+"""Operation pools (reference: packages/beacon-node/src/chain/opPools/).
+
+AttestationPool aggregates unaggregated gossip attestations per slot+data
+(attestationPool.ts:184 naive aggregation via signature addition);
+AggregatedAttestationPool packs aggregates into blocks
+(aggregatedAttestationPool.ts:321); OpPool persists slashings/exits.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+from lodestar_tpu.types import ssz
+
+SLOTS_RETAINED = 3  # attestationPool.ts SLOTS_RETAINED
+MAX_RETAINED_DATAS_PER_SLOT = 16
+
+
+@dataclass
+class _AggregateFast:
+    data: "ssz.phase0.AttestationData"
+    aggregation_bits: List[bool]
+    signature: "bls.Signature"
+
+    def to_attestation(self) -> "ssz.phase0.Attestation":
+        return ssz.phase0.Attestation(
+            aggregation_bits=list(self.aggregation_bits),
+            data=self.data,
+            signature=self.signature.to_bytes(),
+        )
+
+
+class AttestationPool:
+    """Unaggregated attestations, naively aggregated on insert."""
+
+    def __init__(self):
+        # slot -> data_root -> aggregate
+        self._by_slot: Dict[int, Dict[bytes, _AggregateFast]] = {}
+        self.lowest_permissible_slot = 0
+
+    def add(self, attestation: "ssz.phase0.Attestation") -> str:
+        slot = attestation.data.slot
+        if slot < self.lowest_permissible_slot:
+            return "old_slot"
+        data_root = ssz.phase0.AttestationData.hash_tree_root(attestation.data)
+        per_slot = self._by_slot.setdefault(slot, {})
+        agg = per_slot.get(data_root)
+        if agg is None:
+            if len(per_slot) >= MAX_RETAINED_DATAS_PER_SLOT:
+                return "reached_limit"
+            per_slot[data_root] = _AggregateFast(
+                data=attestation.data,
+                aggregation_bits=list(attestation.aggregation_bits),
+                signature=bls.Signature.from_bytes(bytes(attestation.signature)),
+            )
+            return "new_data"
+        bits = attestation.aggregation_bits
+        if len(bits) != len(agg.aggregation_bits):
+            return "bits_mismatch"
+        if any(a and b for a, b in zip(bits, agg.aggregation_bits)):
+            return "already_known"
+        agg.aggregation_bits = [
+            a or b for a, b in zip(agg.aggregation_bits, bits)
+        ]
+        agg.signature = bls.aggregate_signatures(
+            [agg.signature, bls.Signature.from_bytes(bytes(attestation.signature))]
+        )
+        return "aggregated"
+
+    def get_aggregate(self, slot: int, data_root: bytes) -> Optional["ssz.phase0.Attestation"]:
+        agg = self._by_slot.get(slot, {}).get(data_root)
+        return agg.to_attestation() if agg else None
+
+    def prune(self, clock_slot: int) -> None:
+        self.lowest_permissible_slot = max(0, clock_slot - SLOTS_RETAINED)
+        for slot in [s for s in self._by_slot if s < self.lowest_permissible_slot]:
+            del self._by_slot[slot]
+
+
+class AggregatedAttestationPool:
+    """Aggregates awaiting block inclusion; getAttestationsForBlock packs
+    the highest-value ones (most new attesting bits first)."""
+
+    def __init__(self):
+        self._by_data_root: Dict[bytes, List["ssz.phase0.Attestation"]] = {}
+        self.lowest_permissible_slot = 0
+
+    def add(self, attestation: "ssz.phase0.Attestation") -> str:
+        if attestation.data.slot < self.lowest_permissible_slot:
+            return "old_slot"
+        root = ssz.phase0.AttestationData.hash_tree_root(attestation.data)
+        lst = self._by_data_root.setdefault(root, [])
+        new_bits = list(attestation.aggregation_bits)
+        for existing in lst:
+            ex_bits = list(existing.aggregation_bits)
+            if all(not b or e for b, e in zip(new_bits, ex_bits)):
+                return "already_known"  # subset of an existing aggregate
+        lst.append(attestation)
+        return "added"
+
+    def get_attestations_for_block(self, state_slot: int) -> List["ssz.phase0.Attestation"]:
+        candidates: List[Tuple[int, "ssz.phase0.Attestation"]] = []
+        for lst in self._by_data_root.values():
+            for att in lst:
+                if (
+                    att.data.slot + _p.MIN_ATTESTATION_INCLUSION_DELAY
+                    <= state_slot
+                    <= att.data.slot + _p.SLOTS_PER_EPOCH
+                ):
+                    candidates.append((sum(att.aggregation_bits), att))
+        candidates.sort(key=lambda t: -t[0])
+        return [att for _, att in candidates[: _p.MAX_ATTESTATIONS]]
+
+    def prune(self, clock_slot: int) -> None:
+        self.lowest_permissible_slot = max(0, clock_slot - _p.SLOTS_PER_EPOCH)
+        for root in list(self._by_data_root):
+            self._by_data_root[root] = [
+                a
+                for a in self._by_data_root[root]
+                if a.data.slot >= self.lowest_permissible_slot
+            ]
+            if not self._by_data_root[root]:
+                del self._by_data_root[root]
+
+
+class OpPool:
+    """Slashings, exits awaiting inclusion (opPool.ts), persisted via the
+    db repositories on shutdown by the chain."""
+
+    def __init__(self):
+        self.attester_slashings: Dict[bytes, "ssz.phase0.AttesterSlashing"] = {}
+        self.proposer_slashings: Dict[int, "ssz.phase0.ProposerSlashing"] = {}
+        self.voluntary_exits: Dict[int, "ssz.phase0.SignedVoluntaryExit"] = {}
+
+    def add_attester_slashing(self, s) -> None:
+        root = ssz.phase0.AttesterSlashing.hash_tree_root(s)
+        self.attester_slashings[root] = s
+
+    def add_proposer_slashing(self, s) -> None:
+        self.proposer_slashings[s.signed_header_1.message.proposer_index] = s
+
+    def add_voluntary_exit(self, e) -> None:
+        self.voluntary_exits[e.message.validator_index] = e
+
+    def get_slashings_and_exits(self, state) -> Tuple[list, list, list]:
+        from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+
+        epoch = compute_epoch_at_slot(state.slot)
+        proposer = [
+            s
+            for s in self.proposer_slashings.values()
+            if not state.validators[s.signed_header_1.message.proposer_index].slashed
+        ][: _p.MAX_PROPOSER_SLASHINGS]
+        attester = list(self.attester_slashings.values())[: _p.MAX_ATTESTER_SLASHINGS]
+        exits = [
+            e
+            for e in self.voluntary_exits.values()
+            if state.validators[e.message.validator_index].exit_epoch
+            == 2**64 - 1
+            and epoch >= e.message.epoch
+        ][: _p.MAX_VOLUNTARY_EXITS]
+        return proposer, attester, exits

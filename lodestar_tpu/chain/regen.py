@@ -1,0 +1,108 @@
+"""State caches + regeneration (reference:
+packages/beacon-node/src/chain/stateCache/ and chain/regen/queued.ts).
+
+StateContextCache: LRU of post-states by block root.  CheckpointStateCache:
+epoch-boundary states by checkpoint.  StateRegenerator replays blocks from
+the db when a requested state is not cached (regen.getPreState /
+getBlockSlotState semantics), behind a bounded FIFO queue upstream.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, Optional, Tuple
+
+from lodestar_tpu.state_transition import CachedBeaconState, process_slots, state_transition
+
+MAX_STATES = 96  # stateContextCache default
+MAX_CHECKPOINT_STATES = 8
+
+
+class StateContextCache:
+    def __init__(self, max_states: int = MAX_STATES):
+        self._map: "OrderedDict[bytes, CachedBeaconState]" = OrderedDict()
+        self.max_states = max_states
+
+    def get(self, block_root: bytes) -> Optional[CachedBeaconState]:
+        st = self._map.get(block_root)
+        if st is not None:
+            self._map.move_to_end(block_root)
+        return st
+
+    def add(self, block_root: bytes, state: CachedBeaconState) -> None:
+        self._map[block_root] = state
+        self._map.move_to_end(block_root)
+        while len(self._map) > self.max_states:
+            self._map.popitem(last=False)
+
+    def prune(self, keep_roots) -> None:
+        keep = set(keep_roots)
+        for root in [r for r in self._map if r not in keep]:
+            del self._map[root]
+
+    def __len__(self):
+        return len(self._map)
+
+
+class CheckpointStateCache:
+    def __init__(self, max_states: int = MAX_CHECKPOINT_STATES):
+        self._map: "OrderedDict[Tuple[int, bytes], CachedBeaconState]" = OrderedDict()
+        self.max_states = max_states
+
+    def get(self, epoch: int, root: bytes) -> Optional[CachedBeaconState]:
+        st = self._map.get((epoch, root))
+        if st is not None:
+            self._map.move_to_end((epoch, root))
+        return st
+
+    def add(self, epoch: int, root: bytes, state: CachedBeaconState) -> None:
+        self._map[(epoch, root)] = state
+        self._map.move_to_end((epoch, root))
+        while len(self._map) > self.max_states:
+            self._map.popitem(last=False)
+
+
+class StateRegenerator:
+    """Replay-based state regeneration.  get_block_fn(root) must return the
+    stored SignedBeaconBlock for a known root (db.block)."""
+
+    def __init__(self, state_cache: StateContextCache, get_block_fn: Callable):
+        self.state_cache = state_cache
+        self.get_block = get_block_fn
+
+    def get_pre_state(self, parent_root: bytes, slot: int) -> CachedBeaconState:
+        """State to process a block with `parent_root` at `slot` on top of
+        (regen.getPreState)."""
+        state = self.state_cache.get(parent_root)
+        if state is None:
+            state = self._replay_to(parent_root)
+        if state.state.slot < slot:
+            state = state.clone()
+            process_slots(state, slot)
+        return state
+
+    def _replay_to(self, block_root: bytes) -> CachedBeaconState:
+        """Walk back to a cached ancestor, then re-apply blocks forward
+        (the regen miss path — hot on deep reorgs, chain/regen/regen.ts)."""
+        chain = []
+        root = block_root
+        state = None
+        while True:
+            state = self.state_cache.get(root)
+            if state is not None:
+                break
+            block = self.get_block(root)
+            if block is None:
+                raise ValueError(f"cannot regen: unknown block {root.hex()}")
+            chain.append(block)
+            root = bytes(block.message.parent_root)
+        for block in reversed(chain):
+            state = state_transition(
+                state, block,
+                verify_state_root=True, verify_proposer=False, verify_signatures=False,
+            )
+            from lodestar_tpu.types import ssz
+
+            self.state_cache.add(
+                ssz.phase0.BeaconBlock.hash_tree_root(block.message), state
+            )
+        return state

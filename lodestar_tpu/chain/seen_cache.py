@@ -1,0 +1,72 @@
+"""First-seen dedup caches (reference:
+packages/beacon-node/src/chain/seenCache/: seenAttesters, seenAggregators,
+seenBlockProposers, seenCommitteeContribution...).  Epoch-keyed maps pruned
+on finalization, exactly the gossip-dedup semantics the spec requires.
+"""
+from __future__ import annotations
+
+from typing import Dict, Set, Tuple
+
+
+class SeenEpochCache:
+    """validator-per-epoch first-seen cache (seenAttesters.ts)."""
+
+    def __init__(self):
+        self._by_epoch: Dict[int, Set[int]] = {}
+
+    def is_known(self, epoch: int, index: int) -> bool:
+        return index in self._by_epoch.get(epoch, ())
+
+    def add(self, epoch: int, index: int) -> None:
+        self._by_epoch.setdefault(epoch, set()).add(index)
+
+    def prune(self, finalized_epoch: int) -> None:
+        for e in [e for e in self._by_epoch if e <= finalized_epoch]:
+            del self._by_epoch[e]
+
+
+SeenAttesters = SeenEpochCache
+SeenAggregators = SeenEpochCache
+
+
+class SeenBlockProposers:
+    """proposer-per-slot cache (seenBlockProposers.ts)."""
+
+    def __init__(self):
+        self._by_slot: Dict[int, Set[int]] = {}
+
+    def is_known(self, slot: int, proposer: int) -> bool:
+        return proposer in self._by_slot.get(slot, ())
+
+    def add(self, slot: int, proposer: int) -> None:
+        self._by_slot.setdefault(slot, set()).add(proposer)
+
+    def prune(self, finalized_slot: int) -> None:
+        for s in [s for s in self._by_slot if s <= finalized_slot]:
+            del self._by_slot[s]
+
+
+class SeenAggregatedAttestations:
+    """(target epoch, aggregate data root+bits superset) dedup
+    (seenAggregateAndProof.ts simplified to root-key)."""
+
+    def __init__(self):
+        self._by_epoch: Dict[int, Dict[bytes, Tuple[bool, ...]]] = {}
+
+    def is_known_superset(self, epoch: int, data_root: bytes, bits) -> bool:
+        existing = self._by_epoch.get(epoch, {}).get(data_root)
+        if existing is None or len(existing) != len(bits):
+            return False
+        return all(e or not b for e, b in zip(existing, bits))
+
+    def add(self, epoch: int, data_root: bytes, bits) -> None:
+        per = self._by_epoch.setdefault(epoch, {})
+        existing = per.get(data_root)
+        if existing is None or len(existing) != len(bits):
+            per[data_root] = tuple(bits)
+        else:
+            per[data_root] = tuple(a or b for a, b in zip(existing, bits))
+
+    def prune(self, finalized_epoch: int) -> None:
+        for e in [e for e in self._by_epoch if e <= finalized_epoch]:
+            del self._by_epoch[e]

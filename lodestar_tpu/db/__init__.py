@@ -1,0 +1,4 @@
+from .schema import Bucket, encode_key  # noqa: F401
+from .controller import KvController, MemoryController, SqliteController  # noqa: F401
+from .repository import Repository  # noqa: F401
+from .beacon import BeaconDb  # noqa: F401

@@ -1,0 +1,79 @@
+"""BeaconDb: all typed repositories (reference:
+packages/beacon-node/src/db/beacon.ts + repositories/).
+"""
+from __future__ import annotations
+
+from lodestar_tpu.types import ssz
+from lodestar_tpu.ssz.core import Bytes32, uint64
+from .controller import KvController, MemoryController
+from .repository import Repository
+from .schema import Bucket
+
+
+class _RootRepo(Repository):
+    """Values keyed by their hash tree root (e.g. hot blocks)."""
+
+    def __init__(self, db, bucket, ssz_type, root_of):
+        super().__init__(db, bucket, ssz_type, key_length=32)
+        self._root_of = root_of
+
+    def add(self, value) -> bytes:
+        root = self._root_of(value)
+        self.put(root, value)
+        return root
+
+
+class BeaconDb:
+    def __init__(self, controller: KvController = None):
+        db = controller if controller is not None else MemoryController()
+        self.controller = db
+        # hot blocks by root
+        self.block = _RootRepo(
+            db,
+            Bucket.allForks_block,
+            ssz.phase0.SignedBeaconBlock,
+            lambda sb: ssz.phase0.BeaconBlock.hash_tree_root(sb.message),
+        )
+        # finalized chain by slot
+        self.block_archive = Repository(
+            db, Bucket.allForks_blockArchive, ssz.phase0.SignedBeaconBlock
+        )
+        self.block_archive_root_index = Repository(
+            db, Bucket.index_blockArchiveRootIndex, uint64, key_length=32
+        )
+        self.state_archive = Repository(
+            db, Bucket.allForks_stateArchive, ssz.phase0.BeaconState
+        )
+        self.state_archive_root_index = Repository(
+            db, Bucket.index_stateArchiveRootIndex, uint64, key_length=32
+        )
+        self.deposit_event = Repository(
+            db, Bucket.phase0_depositEvent, ssz.phase0.DepositEvent
+        )
+        self.deposit_data_root = Repository(
+            db, Bucket.index_depositDataRoot, Bytes32
+        )
+        self.eth1_data = Repository(
+            db, Bucket.phase0_eth1Data, ssz.phase0.Eth1Data
+        )
+        self.voluntary_exit = Repository(
+            db, Bucket.phase0_exit, ssz.phase0.SignedVoluntaryExit
+        )
+        self.proposer_slashing = Repository(
+            db, Bucket.phase0_proposerSlashing, ssz.phase0.ProposerSlashing
+        )
+        self.attester_slashing = Repository(
+            db, Bucket.phase0_attesterSlashing, ssz.phase0.AttesterSlashing, key_length=32
+        )
+        self.best_light_client_update = Repository(
+            db, Bucket.lightClient_bestLightClientUpdate, ssz.altair.LightClientUpdate
+        )
+        self.checkpoint_header = Repository(
+            db, Bucket.lightClient_checkpointHeader, ssz.phase0.BeaconBlockHeader, key_length=32
+        )
+        self.backfilled_ranges = Repository(
+            db, Bucket.backfilled_ranges, uint64
+        )
+
+    def close(self) -> None:
+        self.controller.close()

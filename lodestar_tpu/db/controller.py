@@ -1,0 +1,122 @@
+"""Key-value store controllers (reference:
+packages/db/src/controller/level.ts backed by C++ leveldown).
+
+The rebuild's durable backend is sqlite3 (stdlib, C storage engine —
+filling leveldown's native-code role without an external dependency): one
+table of (key BLOB PRIMARY KEY, value BLOB) gives ordered iteration and
+range scans like LevelDB.  MemoryController is the test/dev double.
+"""
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterable, Iterator, List, Optional, Protocol, Tuple
+
+
+class KvController(Protocol):
+    def get(self, key: bytes) -> Optional[bytes]: ...
+    def put(self, key: bytes, value: bytes) -> None: ...
+    def delete(self, key: bytes) -> None: ...
+    def batch_put(self, items: Iterable[Tuple[bytes, bytes]]) -> None: ...
+    def keys_range(self, gte: bytes, lt: bytes, reverse: bool = False,
+                   limit: Optional[int] = None) -> Iterator[bytes]: ...
+    def entries_range(self, gte: bytes, lt: bytes, reverse: bool = False,
+                      limit: Optional[int] = None) -> Iterator[Tuple[bytes, bytes]]: ...
+    def close(self) -> None: ...
+
+
+class MemoryController:
+    def __init__(self):
+        self._data = {}
+
+    def get(self, key):
+        return self._data.get(key)
+
+    def put(self, key, value):
+        self._data[bytes(key)] = bytes(value)
+
+    def delete(self, key):
+        self._data.pop(bytes(key), None)
+
+    def batch_put(self, items):
+        for k, v in items:
+            self.put(k, v)
+
+    def entries_range(self, gte, lt, reverse=False, limit=None):
+        keys = sorted(k for k in self._data if gte <= k < lt)
+        if reverse:
+            keys.reverse()
+        if limit is not None:
+            keys = keys[:limit]
+        for k in keys:
+            yield k, self._data[k]
+
+    def keys_range(self, gte, lt, reverse=False, limit=None):
+        for k, _ in self.entries_range(gte, lt, reverse, limit):
+            yield k
+
+    def close(self):
+        self._data.clear()
+
+
+class SqliteController:
+    """Durable KV store; thread-safe via a lock (the asyncio host runs
+    blocking db work in an executor)."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv"
+                " (key BLOB PRIMARY KEY, value BLOB NOT NULL) WITHOUT ROWID"
+            )
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.commit()
+
+    def get(self, key):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM kv WHERE key=?", (bytes(key),)
+            ).fetchone()
+        return row[0] if row else None
+
+    def put(self, key, value):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (key, value) VALUES (?, ?)",
+                (bytes(key), bytes(value)),
+            )
+            self._conn.commit()
+
+    def delete(self, key):
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE key=?", (bytes(key),))
+            self._conn.commit()
+
+    def batch_put(self, items):
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO kv (key, value) VALUES (?, ?)",
+                [(bytes(k), bytes(v)) for k, v in items],
+            )
+            self._conn.commit()
+
+    def entries_range(self, gte, lt, reverse=False, limit=None):
+        order = "DESC" if reverse else "ASC"
+        q = f"SELECT key, value FROM kv WHERE key >= ? AND key < ? ORDER BY key {order}"
+        if limit is not None:
+            q += f" LIMIT {int(limit)}"
+        with self._lock:
+            rows = self._conn.execute(q, (bytes(gte), bytes(lt))).fetchall()
+        for k, v in rows:
+            yield bytes(k), bytes(v)
+
+    def keys_range(self, gte, lt, reverse=False, limit=None):
+        for k, _ in self.entries_range(gte, lt, reverse, limit):
+            yield k
+
+    def close(self):
+        with self._lock:
+            self._conn.close()

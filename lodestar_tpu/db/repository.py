@@ -1,0 +1,101 @@
+"""Typed repository base over a KV bucket (reference:
+packages/db/src/abstractRepository.ts + beacon-node/src/db/repositories/).
+"""
+from __future__ import annotations
+
+from typing import Generic, Iterator, List, Optional, Tuple, TypeVar
+
+from .controller import KvController
+from .schema import Bucket, encode_key
+
+T = TypeVar("T")
+
+
+class Repository(Generic[T]):
+    """Bucketed, SSZ-encoded collection keyed by bytes (roots) or ints
+    (slots/indices, big-endian for ordered scans)."""
+
+    def __init__(self, db: KvController, bucket: Bucket, ssz_type, key_length: int = 8):
+        self.db = db
+        self.bucket = bucket
+        self.type = ssz_type
+        self.key_length = key_length
+
+    # key helpers ------------------------------------------------------
+
+    def _k(self, key) -> bytes:
+        if isinstance(key, int):
+            key = key.to_bytes(self.key_length, "big")
+        return encode_key(self.bucket, key)
+
+    def _decode_id(self, dbkey: bytes):
+        raw = dbkey[1:]
+        return raw
+
+    # value helpers (subclasses may override for non-SSZ values) --------
+
+    def encode_value(self, value: T) -> bytes:
+        return self.type.serialize(value)
+
+    def decode_value(self, data: bytes) -> T:
+        return self.type.deserialize(data)
+
+    # crud -------------------------------------------------------------
+
+    def get(self, key) -> Optional[T]:
+        data = self.db.get(self._k(key))
+        return self.decode_value(data) if data is not None else None
+
+    def get_binary(self, key) -> Optional[bytes]:
+        return self.db.get(self._k(key))
+
+    def has(self, key) -> bool:
+        return self.db.get(self._k(key)) is not None
+
+    def put(self, key, value: T) -> None:
+        self.db.put(self._k(key), self.encode_value(value))
+
+    def put_binary(self, key, data: bytes) -> None:
+        self.db.put(self._k(key), data)
+
+    def delete(self, key) -> None:
+        self.db.delete(self._k(key))
+
+    def batch_put(self, items: List[Tuple[object, T]]) -> None:
+        self.db.batch_put((self._k(k), self.encode_value(v)) for k, v in items)
+
+    # range scans ------------------------------------------------------
+
+    def _bounds(self, gte=None, lt=None) -> Tuple[bytes, bytes]:
+        lo = self._k(gte) if gte is not None else encode_key(self.bucket, b"")
+        hi = (
+            self._k(lt)
+            if lt is not None
+            else bytes([int(self.bucket) + 1])
+        )
+        return lo, hi
+
+    def keys(self, gte=None, lt=None, reverse=False, limit=None) -> Iterator[bytes]:
+        lo, hi = self._bounds(gte, lt)
+        for k in self.db.keys_range(lo, hi, reverse, limit):
+            yield self._decode_id(k)
+
+    def values(self, gte=None, lt=None, reverse=False, limit=None) -> Iterator[T]:
+        lo, hi = self._bounds(gte, lt)
+        for _, v in self.db.entries_range(lo, hi, reverse, limit):
+            yield self.decode_value(v)
+
+    def entries(self, gte=None, lt=None, reverse=False, limit=None):
+        lo, hi = self._bounds(gte, lt)
+        for k, v in self.db.entries_range(lo, hi, reverse, limit):
+            yield self._decode_id(k), self.decode_value(v)
+
+    def first_value(self) -> Optional[T]:
+        for v in self.values(limit=1):
+            return v
+        return None
+
+    def last_value(self) -> Optional[T]:
+        for v in self.values(reverse=True, limit=1):
+            return v
+        return None

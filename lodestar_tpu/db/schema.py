@@ -1,0 +1,48 @@
+"""Database buckets and key encoding (reference: packages/db/src/schema.ts).
+
+Bucket ids match the reference's live (non-deprecated) assignments so a
+database layout diagram from the reference maps 1:1.
+"""
+from __future__ import annotations
+
+from enum import IntEnum
+
+
+class Bucket(IntEnum):
+    allForks_stateArchive = 0           # Root -> BeaconState
+    allForks_block = 1                  # Root -> SignedBeaconBlock
+    allForks_blockArchive = 2           # Slot -> SignedBeaconBlock
+    index_blockArchiveParentRootIndex = 3
+    index_blockArchiveRootIndex = 4
+    index_mainChain = 6                 # Slot -> Root
+    index_chainInfo = 7
+    phase0_eth1Data = 8                 # timestamp -> Eth1Data
+    index_depositDataRoot = 9           # depositIndex -> Root
+    phase0_depositEvent = 19            # depositIndex -> DepositEvent
+    phase0_preGenesisState = 30
+    phase0_preGenesisStateLastProcessedBlock = 31
+    phase0_exit = 13                    # ValidatorIndex -> SignedVoluntaryExit
+    phase0_proposerSlashing = 14
+    phase0_attesterSlashing = 15
+    phase0_slashingProtectionBlockBySlot = 20
+    phase0_slashingProtectionAttestationByTarget = 21
+    phase0_slashingProtectionAttestationLowerBound = 22
+    index_slashingProtectionMinSpanDistance = 23
+    index_slashingProtectionMaxSpanDistance = 24
+    index_stateArchiveRootIndex = 26    # StateRoot -> Slot
+    lightClient_syncCommitteeWitness = 51
+    lightClient_syncCommittee = 52
+    lightClient_checkpointHeader = 53
+    lightClient_bestLightClientUpdate = 55
+    validator_metaData = 41
+    backfilled_ranges = 42
+
+
+def encode_key(bucket: Bucket, key: bytes) -> bytes:
+    """bucket-prefixed key (schema.ts:91 uses a 1-byte prefix; ints are
+    big-endian so range scans order correctly)."""
+    return bytes([int(bucket)]) + key
+
+
+def uint_key(value: int, length: int = 8) -> bytes:
+    return int(value).to_bytes(length, "big")

@@ -1,0 +1,143 @@
+"""Execution-layer Engine API (reference:
+packages/beacon-node/src/execution/engine/{interface,http,mock}.ts).
+
+ExecutionEngine is the protocol the chain consumes (notifyNewPayload /
+notifyForkchoiceUpdate / getPayload); MockExecutionEngine is the in-process
+fake EL (engine/mock.ts role) used by dev chains and merge tests;
+HttpExecutionEngine speaks engine JSON-RPC over aiohttp (http.ts:155).
+"""
+from __future__ import annotations
+
+import os
+import secrets
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, Optional, Protocol
+
+
+class ExecutePayloadStatus(str, Enum):
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+
+
+@dataclass
+class PayloadStatus:
+    status: ExecutePayloadStatus
+    latest_valid_hash: Optional[bytes] = None
+    validation_error: Optional[str] = None
+
+
+class ExecutionEngine(Protocol):
+    async def notify_new_payload(self, payload) -> PayloadStatus: ...
+    async def notify_forkchoice_update(
+        self, head_block_hash: bytes, safe_block_hash: bytes,
+        finalized_block_hash: bytes, payload_attributes=None,
+    ) -> Optional[bytes]: ...
+    async def get_payload(self, payload_id: bytes): ...
+
+
+class MockExecutionEngine:
+    """Accept-everything EL double with payload building
+    (engine/mock.ts)."""
+
+    def __init__(self):
+        self.head: Optional[bytes] = None
+        self.finalized: Optional[bytes] = None
+        self._payloads: Dict[bytes, object] = {}
+        self.notified_payloads = 0
+
+    async def notify_new_payload(self, payload) -> PayloadStatus:
+        self.notified_payloads += 1
+        return PayloadStatus(ExecutePayloadStatus.VALID, getattr(payload, "block_hash", None))
+
+    async def notify_forkchoice_update(
+        self, head_block_hash, safe_block_hash, finalized_block_hash,
+        payload_attributes=None,
+    ) -> Optional[bytes]:
+        self.head = head_block_hash
+        self.finalized = finalized_block_hash
+        if payload_attributes is not None:
+            pid = secrets.token_bytes(8)
+            self._payloads[pid] = payload_attributes
+            return pid
+        return None
+
+    async def get_payload(self, payload_id: bytes):
+        if payload_id not in self._payloads:
+            raise ValueError("unknown payloadId")
+        return self._payloads.pop(payload_id)
+
+
+class HttpExecutionEngine:
+    """engine_* JSON-RPC client (http.ts).  Supports the jwt-secret auth
+    the Engine API requires."""
+
+    def __init__(self, url: str, jwt_secret: Optional[bytes] = None, timeout: float = 12.0):
+        self.url = url
+        self.jwt_secret = jwt_secret
+        self.timeout = timeout
+        self._id = 0
+
+    async def _rpc(self, method: str, params):
+        import aiohttp
+
+        self._id += 1
+        headers = {}
+        if self.jwt_secret is not None:
+            headers["Authorization"] = f"Bearer {self._jwt_token()}"
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                self.url,
+                json={"jsonrpc": "2.0", "id": self._id, "method": method, "params": params},
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=self.timeout),
+            ) as resp:
+                body = await resp.json()
+        if "error" in body:
+            raise RuntimeError(f"{method}: {body['error']}")
+        return body["result"]
+
+    def _jwt_token(self) -> str:
+        """HS256 JWT with iat claim (Engine API auth spec)."""
+        import base64
+        import hashlib
+        import hmac
+        import json
+        import time
+
+        def b64(data: bytes) -> str:
+            return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+        header = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        payload = b64(json.dumps({"iat": int(time.time())}).encode())
+        msg = f"{header}.{payload}".encode()
+        sig = b64(hmac.new(self.jwt_secret, msg, hashlib.sha256).digest())
+        return f"{header}.{payload}.{sig}"
+
+    async def notify_new_payload(self, payload) -> PayloadStatus:
+        result = await self._rpc("engine_newPayloadV1", [payload])
+        return PayloadStatus(
+            ExecutePayloadStatus(result["status"]),
+            bytes.fromhex(result["latestValidHash"][2:]) if result.get("latestValidHash") else None,
+            result.get("validationError"),
+        )
+
+    async def notify_forkchoice_update(
+        self, head_block_hash, safe_block_hash, finalized_block_hash,
+        payload_attributes=None,
+    ) -> Optional[bytes]:
+        fc_state = {
+            "headBlockHash": "0x" + head_block_hash.hex(),
+            "safeBlockHash": "0x" + safe_block_hash.hex(),
+            "finalizedBlockHash": "0x" + finalized_block_hash.hex(),
+        }
+        result = await self._rpc(
+            "engine_forkchoiceUpdatedV1", [fc_state, payload_attributes]
+        )
+        pid = result.get("payloadId")
+        return bytes.fromhex(pid[2:]) if pid else None
+
+    async def get_payload(self, payload_id: bytes):
+        return await self._rpc("engine_getPayloadV1", ["0x" + payload_id.hex()])

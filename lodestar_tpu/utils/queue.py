@@ -1,0 +1,119 @@
+"""Async job queues with backpressure (reference:
+packages/beacon-node/src/util/queue/itemQueue.ts — JobItemQueue with
+LIFO/FIFO order, maxLength drop policy, maxConcurrency).
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from dataclasses import dataclass
+from enum import Enum
+from typing import Awaitable, Callable, Deque, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+class QueueType(str, Enum):
+    FIFO = "FIFO"
+    LIFO = "LIFO"
+
+
+class QueueError(Exception):
+    pass
+
+
+class QueueFullError(QueueError):
+    pass
+
+
+class QueueAbortedError(QueueError):
+    pass
+
+
+@dataclass
+class QueueMetrics:
+    length: int = 0
+    dropped_jobs: int = 0
+    total_jobs: int = 0
+
+
+class JobItemQueue(Generic[T, R]):
+    """Push items; an async processor consumes them with bounded
+    concurrency.  When full, the OLDEST pending job is dropped in LIFO
+    mode (gossip wants freshest first) or the new job is rejected in FIFO
+    mode — matching itemQueue.ts semantics."""
+
+    def __init__(
+        self,
+        process: Callable[[T], Awaitable[R]],
+        max_length: int = 1024,
+        queue_type: QueueType = QueueType.FIFO,
+        max_concurrency: int = 1,
+        name: str = "queue",
+    ):
+        self._process = process
+        self.max_length = max_length
+        self.queue_type = queue_type
+        self.max_concurrency = max_concurrency
+        self.name = name
+        self._items: Deque = collections.deque()
+        self._running = 0
+        self._aborted = False
+        self.metrics = QueueMetrics()
+        self._tasks: set = set()
+
+    def push(self, item: T) -> "asyncio.Future[R]":
+        if self._aborted:
+            raise QueueAbortedError(self.name)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        if len(self._items) >= self.max_length:
+            if self.queue_type is QueueType.LIFO:
+                # drop the oldest pending job to make room
+                _, dropped = self._items.popleft()
+                if not dropped.done():
+                    dropped.set_exception(QueueFullError(self.name))
+                self.metrics.dropped_jobs += 1
+            else:
+                self.metrics.dropped_jobs += 1
+                fut.set_exception(QueueFullError(self.name))
+                return fut
+        self._items.append((item, fut))
+        self.metrics.length = len(self._items)
+        self._pump()
+        return fut
+
+    def _pump(self) -> None:
+        while self._running < self.max_concurrency and self._items:
+            if self.queue_type is QueueType.LIFO:
+                item, fut = self._items.pop()
+            else:
+                item, fut = self._items.popleft()
+            self.metrics.length = len(self._items)
+            self._running += 1
+            task = asyncio.ensure_future(self._run(item, fut))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _run(self, item: T, fut: "asyncio.Future[R]") -> None:
+        try:
+            result = await self._process(item)
+            if not fut.done():
+                fut.set_result(result)
+        except Exception as e:
+            if not fut.done():
+                fut.set_exception(e)
+        finally:
+            self.metrics.total_jobs += 1
+            self._running -= 1
+            self._pump()
+
+    def abort(self) -> None:
+        self._aborted = True
+        while self._items:
+            _, fut = self._items.popleft()
+            if not fut.done():
+                fut.set_exception(QueueAbortedError(self.name))
+        self.metrics.length = 0

@@ -1,0 +1,63 @@
+"""DB layer tests: controllers, repositories, range scans, persistence."""
+import os
+
+import pytest
+
+from lodestar_tpu.db import BeaconDb, MemoryController, SqliteController
+from lodestar_tpu.types import ssz
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def controller(request, tmp_path):
+    if request.param == "memory":
+        c = MemoryController()
+    else:
+        c = SqliteController(str(tmp_path / "db.sqlite"))
+    yield c
+    c.close()
+
+
+def make_block(slot):
+    b = ssz.phase0.SignedBeaconBlock.default()
+    b.message.slot = slot
+    return b
+
+
+class TestBeaconDb:
+    def test_block_add_get_by_root(self, controller):
+        db = BeaconDb(controller)
+        b = make_block(7)
+        root = db.block.add(b)
+        got = db.block.get(root)
+        assert got.message.slot == 7
+        assert db.block.has(root)
+        db.block.delete(root)
+        assert not db.block.has(root)
+
+    def test_block_archive_slot_ordering(self, controller):
+        db = BeaconDb(controller)
+        for slot in (5, 1, 9, 3):
+            db.block_archive.put(slot, make_block(slot))
+        slots = [b.message.slot for b in db.block_archive.values()]
+        assert slots == [1, 3, 5, 9]
+        slots_desc = [b.message.slot for b in db.block_archive.values(reverse=True, limit=2)]
+        assert slots_desc == [9, 5]
+        rng = [b.message.slot for b in db.block_archive.values(gte=3, lt=9)]
+        assert rng == [3, 5]
+
+    def test_deposit_data_roots(self, controller):
+        db = BeaconDb(controller)
+        db.deposit_data_root.batch_put([(i, bytes([i]) * 32) for i in range(4)])
+        assert db.deposit_data_root.get(2) == b"\x02" * 32
+        assert list(db.deposit_data_root.values())[3] == b"\x03" * 32
+
+    def test_sqlite_persists_across_reopen(self, tmp_path):
+        path = str(tmp_path / "p.sqlite")
+        c = SqliteController(path)
+        db = BeaconDb(c)
+        root = db.block.add(make_block(11))
+        db.close()
+        c2 = SqliteController(path)
+        db2 = BeaconDb(c2)
+        assert db2.block.get(root).message.slot == 11
+        db2.close()
