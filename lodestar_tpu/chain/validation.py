@@ -1,0 +1,248 @@
+"""Gossip object validation (reference:
+packages/beacon-node/src/chain/validation/{attestation,aggregateAndProof,
+block}.ts).  Spec gossip conditions; BLS checks go through the chain's
+pluggable verifier with {batchable: True} so they ride the device batching
+window (attestation.ts:141-142).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import List, Optional
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    ATTESTATION_SUBNET_COUNT,
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_SELECTION_PROOF,
+)
+from lodestar_tpu.state_transition.block.phase0 import get_domain
+from lodestar_tpu.state_transition.signature_sets import (
+    get_indexed_attestation_signature_set,
+)
+from lodestar_tpu.state_transition.util.aggregator import (
+    is_aggregator_from_committee_length,
+)
+from lodestar_tpu.state_transition.util.domain import compute_signing_root
+from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+from lodestar_tpu.types import ssz
+from .bls import VerifyOptions
+
+ATTESTATION_PROPAGATION_SLOT_RANGE = 32  # spec p2p constant
+
+
+class GossipErrorCode(str, Enum):
+    FUTURE_SLOT = "FUTURE_SLOT"
+    PAST_SLOT = "PAST_SLOT"
+    NOT_EXACTLY_ONE_BIT = "NOT_EXACTLY_ONE_AGGREGATION_BIT_SET"
+    UNKNOWN_BEACON_BLOCK_ROOT = "UNKNOWN_OR_PREFINALIZED_BEACON_BLOCK_ROOT"
+    INVALID_TARGET = "INVALID_TARGET"
+    WRONG_SUBNET = "INVALID_SUBNET_ID"
+    ATTESTER_ALREADY_SEEN = "ATTESTATION_ALREADY_KNOWN"
+    AGGREGATOR_ALREADY_SEEN = "AGGREGATOR_ALREADY_KNOWN"
+    INVALID_SIGNATURE = "INVALID_SIGNATURE"
+    COMMITTEE_INDEX_OUT_OF_RANGE = "COMMITTEE_INDEX_OUT_OF_RANGE"
+    BITS_LENGTH_MISMATCH = "WRONG_NUMBER_OF_AGGREGATION_BITS"
+    NOT_AGGREGATOR = "INVALID_AGGREGATOR"
+    PROPOSER_ALREADY_SEEN = "REPEAT_PROPOSAL"
+    BLOCK_SLOT_MISMATCH = "INCORRECT_PROPOSER"
+
+
+class GossipValidationError(Exception):
+    def __init__(self, code: GossipErrorCode, message: str = ""):
+        super().__init__(f"{code.value}: {message}")
+        self.code = code
+
+
+def compute_subnet_for_attestation(
+    committees_per_slot: int, slot: int, committee_index: int
+) -> int:
+    slots_since_epoch_start = slot % _p.SLOTS_PER_EPOCH
+    committees_since_epoch_start = committees_per_slot * slots_since_epoch_start
+    return (committees_since_epoch_start + committee_index) % ATTESTATION_SUBNET_COUNT
+
+
+async def validate_gossip_attestation(
+    chain, attestation: "ssz.phase0.Attestation", subnet: Optional[int] = None
+) -> List[int]:
+    """validateGossipAttestation (attestation.ts:15): cheap spec checks
+    first, then the single signature set with batchable=True.  Returns the
+    attesting indices (exactly one)."""
+    data = attestation.data
+    current_slot = chain.clock.current_slot
+
+    if data.slot > current_slot:
+        raise GossipValidationError(GossipErrorCode.FUTURE_SLOT, f"slot {data.slot}")
+    if data.slot + ATTESTATION_PROPAGATION_SLOT_RANGE < current_slot:
+        raise GossipValidationError(GossipErrorCode.PAST_SLOT, f"slot {data.slot}")
+    if data.target.epoch != compute_epoch_at_slot(data.slot):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "target/slot")
+
+    bits = list(attestation.aggregation_bits)
+    if sum(bits) != 1:
+        raise GossipValidationError(GossipErrorCode.NOT_EXACTLY_ONE_BIT)
+
+    head_root = "0x" + bytes(data.beacon_block_root).hex()
+    if not chain.fork_choice.has_block(head_root):
+        raise GossipValidationError(
+            GossipErrorCode.UNKNOWN_BEACON_BLOCK_ROOT, head_root
+        )
+
+    state = chain.get_head_state()
+    epoch_ctx = state.epoch_ctx
+    try:
+        committees_per_slot = epoch_ctx.get_committee_count_per_slot(data.target.epoch)
+    except ValueError:
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "epoch not cached")
+    if data.index >= committees_per_slot:
+        raise GossipValidationError(GossipErrorCode.COMMITTEE_INDEX_OUT_OF_RANGE)
+    if subnet is not None:
+        expected = compute_subnet_for_attestation(
+            committees_per_slot, data.slot, data.index
+        )
+        if subnet != expected:
+            raise GossipValidationError(GossipErrorCode.WRONG_SUBNET, f"{subnet}!={expected}")
+
+    committee = epoch_ctx.get_committee(data.slot, data.index)
+    if len(bits) != len(committee):
+        raise GossipValidationError(GossipErrorCode.BITS_LENGTH_MISMATCH)
+    attester_index = int(committee[bits.index(True)])
+
+    if chain.seen_attesters.is_known(data.target.epoch, attester_index):
+        raise GossipValidationError(
+            GossipErrorCode.ATTESTER_ALREADY_SEEN, str(attester_index)
+        )
+
+    indexed = ssz.phase0.IndexedAttestation(
+        attesting_indices=[attester_index],
+        data=data,
+        signature=attestation.signature,
+    )
+    sig_set = get_indexed_attestation_signature_set(chain.cfg, state.state, indexed)
+    if not await chain.bls.verify_signature_sets(
+        [sig_set], VerifyOptions(batchable=True)
+    ):
+        raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)
+
+    chain.seen_attesters.add(data.target.epoch, attester_index)
+    return [attester_index]
+
+
+async def validate_gossip_aggregate_and_proof(
+    chain, signed_agg: "ssz.altair.SignedContributionAndProof | ssz.phase0.SignedAggregateAndProof"
+) -> List[int]:
+    """validateGossipAggregateAndProof (aggregateAndProof.ts): all three
+    signatures (selection proof, aggregator, aggregate) verified as ONE
+    batchable job (aggregateAndProof.ts:125-130)."""
+    agg_and_proof = signed_agg.message
+    aggregate = agg_and_proof.aggregate
+    data = aggregate.data
+    current_slot = chain.clock.current_slot
+
+    if data.slot > current_slot:
+        raise GossipValidationError(GossipErrorCode.FUTURE_SLOT)
+    if data.slot + ATTESTATION_PROPAGATION_SLOT_RANGE < current_slot:
+        raise GossipValidationError(GossipErrorCode.PAST_SLOT)
+    if data.target.epoch != compute_epoch_at_slot(data.slot):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET)
+
+    head_root = "0x" + bytes(data.beacon_block_root).hex()
+    if not chain.fork_choice.has_block(head_root):
+        raise GossipValidationError(GossipErrorCode.UNKNOWN_BEACON_BLOCK_ROOT)
+
+    data_root = ssz.phase0.AttestationData.hash_tree_root(data)
+    if chain.seen_aggregated_attestations.is_known_superset(
+        data.target.epoch, data_root, list(aggregate.aggregation_bits)
+    ):
+        raise GossipValidationError(GossipErrorCode.ATTESTER_ALREADY_SEEN, "superset")
+    if chain.seen_aggregators.is_known(
+        data.target.epoch, agg_and_proof.aggregator_index
+    ):
+        raise GossipValidationError(GossipErrorCode.AGGREGATOR_ALREADY_SEEN)
+
+    state = chain.get_head_state()
+    epoch_ctx = state.epoch_ctx
+    committee = epoch_ctx.get_committee(data.slot, data.index)
+    bits = list(aggregate.aggregation_bits)
+    if len(bits) != len(committee):
+        raise GossipValidationError(GossipErrorCode.BITS_LENGTH_MISMATCH)
+    if not is_aggregator_from_committee_length(
+        len(committee), bytes(agg_and_proof.selection_proof)
+    ):
+        raise GossipValidationError(GossipErrorCode.NOT_AGGREGATOR)
+    if agg_and_proof.aggregator_index not in [int(c) for c in committee]:
+        raise GossipValidationError(GossipErrorCode.NOT_AGGREGATOR, "not in committee")
+
+    st = state.state
+    aggregator_pk = bls.PublicKey.from_bytes(
+        bytes(st.validators[agg_and_proof.aggregator_index].pubkey)
+    )
+    # 1. selection proof over the slot
+    sel_domain = get_domain(chain.cfg, st, DOMAIN_SELECTION_PROOF, data.target.epoch)
+    sel_root = compute_signing_root(ssz.phase0.Slot, data.slot, sel_domain)
+    sel_set = bls.SignatureSet(
+        aggregator_pk, sel_root,
+        bls.Signature.from_bytes(bytes(agg_and_proof.selection_proof)),
+    )
+    # 2. aggregator signature over the AggregateAndProof
+    agg_domain = get_domain(
+        chain.cfg, st, DOMAIN_AGGREGATE_AND_PROOF, data.target.epoch
+    )
+    agg_root = compute_signing_root(
+        ssz.phase0.AggregateAndProof, agg_and_proof, agg_domain
+    )
+    agg_set = bls.SignatureSet(
+        aggregator_pk, agg_root,
+        bls.Signature.from_bytes(bytes(signed_agg.signature)),
+    )
+    # 3. the aggregate attestation itself
+    indices = [int(committee[i]) for i, b in enumerate(bits) if b]
+    indexed = ssz.phase0.IndexedAttestation(
+        attesting_indices=sorted(indices), data=data, signature=aggregate.signature
+    )
+    att_set = get_indexed_attestation_signature_set(chain.cfg, st, indexed)
+
+    ok = await chain.bls.verify_signature_sets(
+        [sel_set, agg_set, att_set], VerifyOptions(batchable=True)
+    )
+    if not ok:
+        raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)
+
+    chain.seen_aggregators.add(data.target.epoch, agg_and_proof.aggregator_index)
+    chain.seen_aggregated_attestations.add(data.target.epoch, data_root, bits)
+    return indices
+
+
+async def validate_gossip_block(chain, signed_block) -> None:
+    """validateGossipBlock (block.ts): slot/proposer/parent checks + the
+    proposer signature (verified on its own, not batchable — blocks gate
+    further processing)."""
+    block = signed_block.message
+    current_slot = chain.clock.current_slot
+    if block.slot > current_slot:
+        raise GossipValidationError(GossipErrorCode.FUTURE_SLOT, f"{block.slot}")
+    fin = chain.fork_choice.store.finalized
+    if block.slot <= fin.epoch * _p.SLOTS_PER_EPOCH:
+        raise GossipValidationError(GossipErrorCode.PAST_SLOT, "pre-finalized")
+    if chain.seen_block_proposers.is_known(block.slot, block.proposer_index):
+        raise GossipValidationError(GossipErrorCode.PROPOSER_ALREADY_SEEN)
+    parent_root = "0x" + bytes(block.parent_root).hex()
+    if not chain.fork_choice.has_block(parent_root):
+        raise GossipValidationError(GossipErrorCode.UNKNOWN_BEACON_BLOCK_ROOT, "parent")
+
+    state = chain.get_head_state()
+    if compute_epoch_at_slot(block.slot) == state.epoch_ctx.epoch:
+        expected = state.epoch_ctx.get_beacon_proposer(block.slot)
+        if block.proposer_index != expected:
+            raise GossipValidationError(GossipErrorCode.BLOCK_SLOT_MISMATCH)
+
+    from lodestar_tpu.state_transition.signature_sets import (
+        get_block_proposer_signature_set,
+    )
+
+    sig_set = get_block_proposer_signature_set(
+        chain.cfg, state.state, state.epoch_ctx, signed_block
+    )
+    if not await chain.bls.verify_signature_sets([sig_set], VerifyOptions()):
+        raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)

@@ -1,0 +1,37 @@
+"""Aggregator selection (reference:
+packages/state-transition/src/util/aggregator.ts, validated by the
+reference's aggregator.test.ts fixtures).
+
+is_aggregator: hash(slot_signature)[0:8] as LE uint64 modulo
+(committee_size // TARGET_AGGREGATORS_PER_COMMITTEE) == 0.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from lodestar_tpu.params import (
+    SYNC_COMMITTEE_SIZE,
+    SYNC_COMMITTEE_SUBNET_COUNT,
+    TARGET_AGGREGATORS_PER_COMMITTEE,
+    TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+)
+
+
+def _is_selection_proof_valid(sig_bytes: bytes, modulo: int) -> bool:
+    digest = hashlib.sha256(sig_bytes).digest()
+    return int.from_bytes(digest[0:8], "little") % modulo == 0
+
+
+def is_aggregator_from_committee_length(committee_length: int, slot_signature: bytes) -> bool:
+    modulo = max(1, committee_length // TARGET_AGGREGATORS_PER_COMMITTEE)
+    return _is_selection_proof_valid(slot_signature, modulo)
+
+
+def is_sync_committee_aggregator(selection_proof: bytes) -> bool:
+    modulo = max(
+        1,
+        SYNC_COMMITTEE_SIZE
+        // SYNC_COMMITTEE_SUBNET_COUNT
+        // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    )
+    return _is_selection_proof_valid(selection_proof, modulo)
