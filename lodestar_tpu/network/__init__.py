@@ -1,0 +1,4 @@
+from .transport import Endpoint, InProcessHub  # noqa: F401
+from .network import Network  # noqa: F401
+from .gossip import Eth2Gossip, GossipType  # noqa: F401
+from .peers import PeerAction, PeerManager, PeerRpcScoreStore  # noqa: F401

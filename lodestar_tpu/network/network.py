@@ -1,0 +1,240 @@
+"""Network: gossip + reqresp + peers composed over a transport endpoint
+(reference: beacon-node/src/network/network.ts:40 Network).
+
+Wires the chain into the network: gossip handlers feed validation then the
+chain/pools (gossip/handlers/index.ts:79); reqresp serves status, ping,
+metadata, goodbye and block download from the db
+(network/reqresp/handlers/).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+from lodestar_tpu.config import compute_fork_digest
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+from lodestar_tpu.types import ssz
+from .gossip import Eth2Gossip, GossipType
+from .peers import PeerAction, PeerManager
+from .reqresp import encoding as rr_enc
+from .reqresp.encoding import ReqRespError, RespStatus
+from .reqresp.protocols import (
+    BEACON_BLOCKS_BY_RANGE,
+    BEACON_BLOCKS_BY_ROOT,
+    GOODBYE,
+    METADATA,
+    PING,
+    STATUS,
+)
+from .reqresp.reqresp import ReqRespNode
+from .transport import Endpoint, InProcessHub
+
+
+class Network:
+    def __init__(self, hub: InProcessHub, chain, db, peer_id: Optional[str] = None):
+        self.chain = chain
+        self.db = db
+        self.endpoint = Endpoint(hub, peer_id)
+        self.peer_id = self.endpoint.peer_id
+        fork_digest = compute_fork_digest(
+            chain.cfg.GENESIS_FORK_VERSION, chain.genesis_validators_root
+        )
+        self.gossip = Eth2Gossip(self.endpoint, fork_digest)
+        self.reqresp = ReqRespNode(self.endpoint)
+        self.peer_manager = PeerManager()
+        self.metadata = ssz.phase0.Metadata(seq_number=0, attnets=[False] * 64)
+        self._register_reqresp_handlers()
+
+    # ------------------------------------------------------------------
+    # reqresp server handlers (network/reqresp/handlers/)
+    # ------------------------------------------------------------------
+
+    def _register_reqresp_handlers(self) -> None:
+        async def on_status(from_peer, req):
+            self.peer_manager.on_connect(from_peer).status = req
+            return [self.local_status()]
+
+        async def on_ping(from_peer, req):
+            return [self.metadata.seq_number]
+
+        async def on_metadata(from_peer, req):
+            return [self.metadata]
+
+        async def on_goodbye(from_peer, req):
+            self.peer_manager.on_disconnect(from_peer)
+            return [0]
+
+        async def on_blocks_by_range(from_peer, req):
+            if req.count > 1024 or req.step < 1:
+                raise ReqRespError(RespStatus.INVALID_REQUEST, "bad range")
+            out = []
+            head_root = self.chain.head_root
+            # walk fork choice canonical chain + finalized archive
+            for slot in range(req.start_slot, req.start_slot + req.count * req.step, req.step):
+                blk = self._block_at_slot(slot)
+                if blk is not None:
+                    out.append(blk)
+            return out
+
+        async def on_blocks_by_root(from_peer, req):
+            out = []
+            for root in req:
+                blk = self.db.block.get(bytes(root))
+                if blk is not None:
+                    out.append(blk)
+            return out
+
+        self.reqresp.register_handler(STATUS, on_status)
+        self.reqresp.register_handler(PING, on_ping)
+        self.reqresp.register_handler(METADATA, on_metadata)
+        self.reqresp.register_handler(GOODBYE, on_goodbye)
+        self.reqresp.register_handler(BEACON_BLOCKS_BY_RANGE, on_blocks_by_range)
+        self.reqresp.register_handler(BEACON_BLOCKS_BY_ROOT, on_blocks_by_root)
+
+    def _block_at_slot(self, slot: int):
+        # canonical root via fork choice ancestors of head
+        node = self.chain.fork_choice.proto_array.get_ancestor_at_or_before_slot(
+            "0x" + self.chain.head_root.hex(), slot
+        )
+        if node is not None and node.slot == slot:
+            return self.db.block.get(bytes.fromhex(node.block_root[2:]))
+        blk = self.db.block_archive.get(slot)
+        return blk
+
+    def local_status(self) -> "ssz.phase0.Status":
+        store = self.chain.fork_choice.store
+        head = self.chain.fork_choice.get_head()
+        return ssz.phase0.Status(
+            fork_digest=self.gossip.fork_digest,
+            finalized_root=bytes.fromhex(store.finalized.root[2:]),
+            finalized_epoch=store.finalized.epoch,
+            head_root=bytes.fromhex(head.block_root[2:]),
+            head_slot=head.slot,
+        )
+
+    # ------------------------------------------------------------------
+    # client helpers
+    # ------------------------------------------------------------------
+
+    async def connect(self, peer: str) -> "ssz.phase0.Status":
+        """Status handshake (peerManager onConnect flow)."""
+        status = (await self.reqresp.request(peer, STATUS, self.local_status()))[0]
+        self.peer_manager.on_connect(peer).status = status
+        return status
+
+    async def blocks_by_range(self, peer: str, start_slot: int, count: int) -> List:
+        from .reqresp.protocols import BeaconBlocksByRangeRequest
+
+        try:
+            return await self.reqresp.request(
+                peer,
+                BEACON_BLOCKS_BY_RANGE,
+                BeaconBlocksByRangeRequest(start_slot=start_slot, count=count, step=1),
+            )
+        except (ReqRespError, asyncio.TimeoutError):
+            self.peer_manager.scores.apply_action(peer, PeerAction.LowToleranceError)
+            raise
+
+    async def blocks_by_root(self, peer: str, roots: List[bytes]) -> List:
+        return await self.reqresp.request(peer, BEACON_BLOCKS_BY_ROOT, list(roots))
+
+    # ------------------------------------------------------------------
+    # gossip wiring (gossip/handlers/index.ts)
+    # ------------------------------------------------------------------
+
+    def subscribe_core_topics(self) -> None:
+        from lodestar_tpu.chain.validation import (
+            GossipValidationError,
+            validate_gossip_aggregate_and_proof,
+            validate_gossip_attestation,
+            validate_gossip_block,
+        )
+
+        async def on_block(from_peer, signed_block):
+            try:
+                await validate_gossip_block(self.chain, signed_block)
+            except GossipValidationError:
+                self.peer_manager.scores.apply_action(
+                    from_peer, PeerAction.LowToleranceError
+                )
+                raise
+            await self.chain.process_block(signed_block)
+
+        async def on_aggregate(from_peer, signed_agg):
+            try:
+                indices = await validate_gossip_aggregate_and_proof(
+                    self.chain, signed_agg
+                )
+            except GossipValidationError:
+                self.peer_manager.scores.apply_action(
+                    from_peer, PeerAction.LowToleranceError
+                )
+                raise
+            agg = signed_agg.message.aggregate
+            self.chain.aggregated_attestation_pool.add(agg)
+            self.chain.fork_choice.on_attestation(
+                indices,
+                "0x" + bytes(agg.data.beacon_block_root).hex(),
+                agg.data.target.epoch,
+            )
+
+        self.gossip.subscribe(
+            GossipType.beacon_block, ssz.phase0.SignedBeaconBlock, on_block
+        )
+        self.gossip.subscribe(
+            GossipType.beacon_aggregate_and_proof,
+            ssz.phase0.SignedAggregateAndProof,
+            on_aggregate,
+        )
+
+    def subscribe_attestation_subnet(self, subnet: int) -> None:
+        from lodestar_tpu.chain.validation import (
+            GossipValidationError,
+            validate_gossip_attestation,
+        )
+
+        async def on_attestation(from_peer, attestation):
+            try:
+                indices = await validate_gossip_attestation(
+                    self.chain, attestation, subnet
+                )
+            except GossipValidationError:
+                self.peer_manager.scores.apply_action(
+                    from_peer, PeerAction.HighToleranceError
+                )
+                raise
+            self.chain.attestation_pool.add(attestation)
+            self.chain.fork_choice.on_attestation(
+                indices,
+                "0x" + bytes(attestation.data.beacon_block_root).hex(),
+                attestation.data.target.epoch,
+            )
+
+        self.gossip.subscribe(
+            GossipType.beacon_attestation,
+            ssz.phase0.Attestation,
+            on_attestation,
+            subnet=subnet,
+        )
+        self.metadata.attnets[subnet] = True
+        self.metadata.seq_number += 1
+
+    async def publish_block(self, signed_block) -> int:
+        return await self.gossip.publish(
+            GossipType.beacon_block, ssz.phase0.SignedBeaconBlock, signed_block
+        )
+
+    async def publish_attestation(self, attestation, subnet: int) -> int:
+        return await self.gossip.publish(
+            GossipType.beacon_attestation, ssz.phase0.Attestation, attestation, subnet
+        )
+
+    async def publish_aggregate(self, signed_agg) -> int:
+        return await self.gossip.publish(
+            GossipType.beacon_aggregate_and_proof,
+            ssz.phase0.SignedAggregateAndProof,
+            signed_agg,
+        )
+
+    def close(self) -> None:
+        self.endpoint.close()

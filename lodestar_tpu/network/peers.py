@@ -1,0 +1,108 @@
+"""Peer manager + scoring (reference:
+beacon-node/src/network/peers/{peerManager,score}.ts, simplified to the
+semantics that matter: per-peer score with decay, ban threshold,
+status/metadata tracking, disconnect of banned peers).
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional
+
+
+class PeerAction(float, Enum):
+    """Score deltas (score.ts PeerAction)."""
+
+    Fatal = -(2**10)
+    LowToleranceError = -10.0
+    MidToleranceError = -5.0
+    HighToleranceError = -1.0
+
+
+MIN_SCORE = -100.0
+DEFAULT_BAN_THRESHOLD = -50.0
+DISCONNECT_THRESHOLD = -20.0
+SCORE_HALFLIFE_S = 600.0
+
+
+@dataclass
+class PeerInfo:
+    peer_id: str
+    score: float = 0.0
+    last_update: float = field(default_factory=time.monotonic)
+    status: Optional[object] = None      # ssz Status
+    metadata: Optional[object] = None    # ssz Metadata
+    ping_seq: int = 0
+    connected: bool = True
+
+
+class PeerRpcScoreStore:
+    def __init__(self, now=time.monotonic):
+        self._peers: Dict[str, PeerInfo] = {}
+        self._now = now
+
+    def peer(self, peer_id: str) -> PeerInfo:
+        if peer_id not in self._peers:
+            self._peers[peer_id] = PeerInfo(peer_id, last_update=self._now())
+        return self._peers[peer_id]
+
+    def apply_action(self, peer_id: str, action: PeerAction) -> float:
+        p = self.peer(peer_id)
+        self._decay(p)
+        p.score = max(MIN_SCORE, p.score + float(action.value))
+        return p.score
+
+    def score(self, peer_id: str) -> float:
+        p = self.peer(peer_id)
+        self._decay(p)
+        return p.score
+
+    def is_banned(self, peer_id: str) -> bool:
+        return self.score(peer_id) < DEFAULT_BAN_THRESHOLD
+
+    def should_disconnect(self, peer_id: str) -> bool:
+        return self.score(peer_id) < DISCONNECT_THRESHOLD
+
+    def _decay(self, p: PeerInfo) -> None:
+        now = self._now()
+        dt = now - p.last_update
+        if dt > 0:
+            p.score *= 0.5 ** (dt / SCORE_HALFLIFE_S)
+            p.last_update = now
+
+
+class PeerManager:
+    """Tracks connected peers; periodic ping/status handled by the
+    Network's heartbeat (peerManager.ts)."""
+
+    def __init__(self, scores: Optional[PeerRpcScoreStore] = None):
+        self.scores = scores or PeerRpcScoreStore()
+        self.peers: Dict[str, PeerInfo] = {}
+
+    def on_connect(self, peer_id: str) -> PeerInfo:
+        info = self.scores.peer(peer_id)
+        info.connected = True
+        self.peers[peer_id] = info
+        return info
+
+    def on_disconnect(self, peer_id: str) -> None:
+        info = self.peers.pop(peer_id, None)
+        if info:
+            info.connected = False
+
+    def connected_peers(self) -> List[str]:
+        return [p for p, i in self.peers.items() if i.connected and not self.scores.is_banned(p)]
+
+    def best_peers(self, min_head_slot: int = 0) -> List[str]:
+        """Peers whose reported head is usable for syncing, best score
+        first."""
+        out = []
+        for pid in self.connected_peers():
+            info = self.peers[pid]
+            head_slot = getattr(info.status, "head_slot", 0) if info.status else 0
+            if head_slot >= min_head_slot:
+                out.append((self.scores.score(pid), pid))
+        out.sort(reverse=True)
+        return [pid for _, pid in out]

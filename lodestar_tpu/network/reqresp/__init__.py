@@ -1,0 +1,14 @@
+from .encoding import ReqRespError, RespStatus  # noqa: F401
+from .protocols import (  # noqa: F401
+    ALL_PROTOCOLS,
+    BEACON_BLOCKS_BY_RANGE,
+    BEACON_BLOCKS_BY_ROOT,
+    GOODBYE,
+    METADATA,
+    PING,
+    STATUS,
+    BeaconBlocksByRangeRequest,
+    Protocol,
+)
+from .rate_limiter import RateLimiterGCRA  # noqa: F401
+from .reqresp import ReqRespNode  # noqa: F401

@@ -1,0 +1,150 @@
+"""ssz_snappy reqresp encoding (reference:
+packages/reqresp/src/encodingStrategies/sszSnappy/{encode,decode}.ts:27):
+unsigned protobuf varint of the SSZ byte length, then the payload as a
+snappy FRAMED stream.  Response streams carry one result byte per chunk
+(0 = success, 1 = InvalidRequest, 2 = ServerError, 3 = ResourceUnavailable)
+before the encoded payload; error chunks carry an ssz_snappy ErrorMessage.
+"""
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Iterator, List, Optional, Tuple
+
+from lodestar_tpu.utils.snappy import (
+    _read_uvarint,
+    _write_uvarint,
+    frame_compress,
+    frame_decompress,
+)
+
+MAX_PAYLOAD = 10 * 1024 * 1024
+
+
+class RespStatus(IntEnum):
+    SUCCESS = 0
+    INVALID_REQUEST = 1
+    SERVER_ERROR = 2
+    RESOURCE_UNAVAILABLE = 3
+
+
+class ReqRespError(Exception):
+    def __init__(self, status: RespStatus, message: str = ""):
+        super().__init__(f"{status.name}: {message}")
+        self.status = status
+
+
+def encode_payload(ssz_type, value) -> bytes:
+    data = ssz_type.serialize(value)
+    return _write_uvarint(len(data)) + frame_compress(data)
+
+
+def decode_payload(ssz_type, data: bytes) -> Tuple[object, int]:
+    """Decode one varint+framed payload; returns (value, bytes_consumed)."""
+    length, pos = _read_uvarint(data, 0)
+    if length > MAX_PAYLOAD:
+        raise ValueError(f"payload too large: {length}")
+    raw = frame_decompress_prefix(data[pos:], length)
+    consumed = pos + raw[1]
+    return ssz_type.deserialize(raw[0]), consumed
+
+
+def frame_decompress_prefix(data: bytes, want: int) -> Tuple[bytes, int]:
+    """Decompress frames until `want` bytes produced; returns
+    (payload, compressed_bytes_consumed).  Needed because response streams
+    concatenate chunks back-to-back."""
+    import struct
+
+    from lodestar_tpu.utils.snappy import STREAM_IDENTIFIER, _masked_crc, decompress
+
+    pos = 0
+    out = bytearray()
+    seen_id = False
+    while len(out) < want:
+        if pos + 4 > len(data):
+            raise ValueError("truncated frame header")
+        kind = data[pos]
+        length = int.from_bytes(data[pos + 1 : pos + 4], "little")
+        body = data[pos + 4 : pos + 4 + length]
+        if len(body) != length:
+            raise ValueError("truncated frame body")
+        pos += 4 + length
+        if kind == 0xFF:
+            seen_id = True
+            continue
+        if not seen_id:
+            raise ValueError("missing stream identifier")
+        if kind == 0x00:
+            crc = struct.unpack("<I", body[:4])[0]
+            chunk = decompress(body[4:])
+        elif kind == 0x01:
+            crc = struct.unpack("<I", body[:4])[0]
+            chunk = body[4:]
+        elif 0x80 <= kind <= 0xFD:
+            continue
+        else:
+            raise ValueError(f"unknown frame kind {kind:#x}")
+        if _masked_crc(chunk) != crc:
+            raise ValueError("frame crc mismatch")
+        out += chunk
+    if len(out) != want:
+        raise ValueError("frame overshoot")
+    return bytes(out), pos
+
+
+# ---------------------------------------------------------------------------
+# request / response streams
+# ---------------------------------------------------------------------------
+
+
+def encode_request(ssz_type, value) -> bytes:
+    if ssz_type is None:
+        return b""
+    return encode_payload(ssz_type, value)
+
+
+def decode_request(ssz_type, data: bytes):
+    if ssz_type is None:
+        return None
+    value, _ = decode_payload(ssz_type, data)
+    return value
+
+
+def encode_response_chunks(ssz_type, values, context_bytes: bytes = b"") -> bytes:
+    """Success chunks: <result=0><context><varint><frames> per value."""
+    out = bytearray()
+    for v in values:
+        out += bytes([RespStatus.SUCCESS]) + context_bytes + encode_payload(ssz_type, v)
+    return bytes(out)
+
+
+def encode_error_chunk(status: RespStatus, message: str) -> bytes:
+    from lodestar_tpu.ssz.core import ByteListT
+
+    err_t = ByteListT(256)
+    return bytes([status]) + encode_payload(err_t, message.encode()[:256])
+
+
+def decode_response_chunks(ssz_type, data: bytes, context_bytes_len: int = 0):
+    """Yield decoded values; raise ReqRespError on an error chunk."""
+    pos = 0
+    out = []
+    contexts = []
+    while pos < len(data):
+        status = data[pos]
+        pos += 1
+        if status != RespStatus.SUCCESS:
+            from lodestar_tpu.ssz.core import ByteListT
+
+            try:
+                msg, _ = decode_payload(ByteListT(256), data[pos:])
+                text = bytes(msg).decode(errors="replace")
+            except Exception:
+                text = ""
+            raise ReqRespError(RespStatus(status), text)
+        ctx = data[pos : pos + context_bytes_len]
+        pos += context_bytes_len
+        value, consumed = decode_payload(ssz_type, data[pos:])
+        pos += consumed
+        out.append(value)
+        contexts.append(ctx)
+    return out, contexts

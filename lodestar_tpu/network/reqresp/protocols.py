@@ -1,0 +1,58 @@
+"""ReqResp protocol registry (reference: packages/reqresp/src/protocols/ +
+beacon-node/src/network/reqresp/types.ts:22-32).
+
+Protocol IDs follow the wire spec:
+/eth2/beacon_chain/req/{method}/{version}/ssz_snappy
+
+NOTE: no `from __future__ import annotations` here — SSZ Container field
+annotations must be live type objects, not strings.
+"""
+from dataclasses import dataclass
+from typing import Optional
+
+from lodestar_tpu.ssz.core import Container, uint64
+from lodestar_tpu.types import ssz
+
+
+class BeaconBlocksByRangeRequest(Container):
+    start_slot: uint64
+    count: uint64
+    step: uint64
+
+
+from lodestar_tpu.ssz.core import Bytes32, List as SszList  # noqa: E402
+
+BeaconBlocksByRootRequest = SszList[Bytes32, 1024]  # MAX_REQUEST_BLOCKS
+
+
+@dataclass(frozen=True)
+class Protocol:
+    method: str
+    version: int
+    request_type: Optional[object]   # SSZ type or None (metadata)
+    response_type: Optional[object]  # SSZ type or None (goodbye has resp? yes uint64)
+    # max chunks a response may contain (None = single chunk)
+    max_response_chunks: Optional[int] = 1
+
+    @property
+    def protocol_id(self) -> str:
+        return f"/eth2/beacon_chain/req/{self.method}/{self.version}/ssz_snappy"
+
+
+STATUS = Protocol("status", 1, ssz.phase0.Status, ssz.phase0.Status)
+GOODBYE = Protocol("goodbye", 1, uint64, uint64)
+PING = Protocol("ping", 1, uint64, uint64)
+METADATA = Protocol("metadata", 2, None, ssz.phase0.Metadata)
+BEACON_BLOCKS_BY_RANGE = Protocol(
+    "beacon_blocks_by_range", 1, BeaconBlocksByRangeRequest,
+    ssz.phase0.SignedBeaconBlock, max_response_chunks=1024,
+)
+BEACON_BLOCKS_BY_ROOT = Protocol(
+    "beacon_blocks_by_root", 1, BeaconBlocksByRootRequest,
+    ssz.phase0.SignedBeaconBlock, max_response_chunks=1024,
+)
+
+ALL_PROTOCOLS = [
+    STATUS, GOODBYE, PING, METADATA, BEACON_BLOCKS_BY_RANGE, BEACON_BLOCKS_BY_ROOT
+]
+BY_ID = {p.protocol_id: p for p in ALL_PROTOCOLS}

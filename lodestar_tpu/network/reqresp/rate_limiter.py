@@ -1,0 +1,34 @@
+"""GCRA rate limiter (reference:
+packages/reqresp/src/rate_limiter/rateLimiterGRCA.ts).
+
+Generic Cell Rate Algorithm: a theoretical-arrival-time per key; a request
+of weight w is allowed iff TAT <= now + burst_window.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Hashable
+
+
+class RateLimiterGCRA:
+    def __init__(self, quota: int, quota_time_ms: int, now=time.monotonic):
+        """Allow `quota` units per `quota_time_ms` window with full-burst
+        tolerance (matches rateLimiterGRCA.ts::fromQuota)."""
+        self._emission_ms = quota_time_ms / max(1, quota)
+        self._burst_ms = quota_time_ms
+        self._tat: Dict[Hashable, float] = {}
+        self._now = now
+
+    def allows(self, key: Hashable, weight: int = 1) -> bool:
+        now_ms = self._now() * 1e3
+        tat = self._tat.get(key, now_ms)
+        new_tat = max(tat, now_ms) + weight * self._emission_ms
+        if new_tat - now_ms > self._burst_ms:
+            return False
+        self._tat[key] = new_tat
+        return True
+
+    def prune(self, older_than_ms: float = 60_000) -> None:
+        now_ms = self._now() * 1e3
+        for k in [k for k, t in self._tat.items() if t < now_ms - older_than_ms]:
+            del self._tat[k]

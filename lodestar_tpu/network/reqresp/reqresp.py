@@ -1,0 +1,74 @@
+"""ReqResp node: typed request/response over a transport endpoint
+(reference: packages/reqresp/src/ReqResp.ts +
+beacon-node/src/network/reqresp/ReqRespBeaconNode.ts).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from lodestar_tpu.network.transport import Endpoint
+from .encoding import (
+    RespStatus,
+    ReqRespError,
+    decode_request,
+    decode_response_chunks,
+    encode_error_chunk,
+    encode_request,
+    encode_response_chunks,
+)
+from .protocols import ALL_PROTOCOLS, BY_ID, Protocol
+from .rate_limiter import RateLimiterGCRA
+
+REQUEST_TIMEOUT_S = 10.0
+
+
+class ReqRespNode:
+    """Registers protocol handlers on an Endpoint and offers typed
+    client-side requests with rate limiting and timeouts."""
+
+    def __init__(self, endpoint: Endpoint, rate_quota=(50, 10_000)):
+        self.endpoint = endpoint
+        self._handlers: Dict[str, Callable] = {}
+        self.rate_limiter = RateLimiterGCRA(*rate_quota)
+
+    # server side ------------------------------------------------------
+
+    def register_handler(
+        self,
+        protocol: Protocol,
+        handler: Callable[[str, object], Awaitable[List[object]]],
+    ) -> None:
+        """handler(from_peer, request_value) -> list of response values."""
+
+        async def raw_handler(from_peer: str, protocol_id: str, data: bytes) -> bytes:
+            if not self.rate_limiter.allows((from_peer, protocol.method)):
+                return encode_error_chunk(RespStatus.INVALID_REQUEST, "rate limited")
+            try:
+                req = decode_request(protocol.request_type, data)
+            except Exception as e:
+                return encode_error_chunk(RespStatus.INVALID_REQUEST, str(e))
+            try:
+                values = await handler(from_peer, req)
+            except ReqRespError as e:
+                return encode_error_chunk(e.status, str(e))
+            except Exception as e:
+                return encode_error_chunk(RespStatus.SERVER_ERROR, str(e))
+            return encode_response_chunks(protocol.response_type, values)
+
+        self.endpoint.handle(protocol.protocol_id, raw_handler)
+
+    # client side ------------------------------------------------------
+
+    async def request(
+        self, peer: str, protocol: Protocol, request_value=None,
+        timeout: float = REQUEST_TIMEOUT_S,
+    ) -> List[object]:
+        data = encode_request(protocol.request_type, request_value)
+        raw = await asyncio.wait_for(
+            self.endpoint.request(peer, protocol.protocol_id, data), timeout
+        )
+        values, _ = decode_response_chunks(protocol.response_type, raw)
+        if protocol.max_response_chunks is not None and len(values) > protocol.max_response_chunks:
+            raise ReqRespError(RespStatus.INVALID_REQUEST, "too many chunks")
+        return values

@@ -1,0 +1,111 @@
+"""In-process network transport — the loopback fabric multi-node sim tests
+run on (reference: beacon-node/test/utils/network.ts wires N in-process
+nodes over loopback libp2p; SURVEY §4 "Sim / multi-node").
+
+The Hub routes reqresp calls and gossip publishes between registered
+endpoints with optional per-link latency, mimicking the libp2p seams
+(streams + pubsub) the production stack would provide; the consuming code
+(ReqRespNode, Eth2Gossip, Network) is transport-agnostic.
+"""
+from __future__ import annotations
+
+import asyncio
+import secrets
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional, Set, Tuple
+
+RequestHandler = Callable[[str, str, bytes], Awaitable[bytes]]
+# (from_peer, topic, raw_message) -> None
+GossipHandler = Callable[[str, str, bytes], Awaitable[None]]
+
+
+def random_peer_id() -> str:
+    return "16U" + secrets.token_hex(16)
+
+
+class InProcessHub:
+    def __init__(self, latency_s: float = 0.0):
+        self.endpoints: Dict[str, "Endpoint"] = {}
+        self.latency_s = latency_s
+
+    def register(self, endpoint: "Endpoint") -> None:
+        self.endpoints[endpoint.peer_id] = endpoint
+
+    def unregister(self, peer_id: str) -> None:
+        self.endpoints.pop(peer_id, None)
+
+    async def request(
+        self, from_peer: str, to_peer: str, protocol_id: str, data: bytes
+    ) -> bytes:
+        ep = self.endpoints.get(to_peer)
+        if ep is None:
+            raise ConnectionError(f"unknown peer {to_peer}")
+        if self.latency_s:
+            await asyncio.sleep(self.latency_s)
+        handler = ep.request_handlers.get(protocol_id)
+        if handler is None:
+            raise ConnectionError(f"{to_peer} does not speak {protocol_id}")
+        return await handler(from_peer, protocol_id, data)
+
+    async def publish(self, from_peer: str, topic: str, message: bytes) -> int:
+        """Deliver to every subscribed endpoint except the sender; returns
+        receiver count (gossipsub mesh broadcast collapsed to one hop)."""
+        count = 0
+        for ep in list(self.endpoints.values()):
+            if ep.peer_id == from_peer:
+                continue
+            handler = ep.subscriptions.get(topic)
+            if handler is None:
+                continue
+            count += 1
+            if self.latency_s:
+                await asyncio.sleep(self.latency_s)
+            ep.deliver(from_peer, topic, message)
+        return count
+
+    def peers_of(self, peer_id: str) -> List[str]:
+        return [p for p in self.endpoints if p != peer_id]
+
+
+class Endpoint:
+    """One node's attachment to the hub."""
+
+    def __init__(self, hub: InProcessHub, peer_id: Optional[str] = None):
+        self.hub = hub
+        self.peer_id = peer_id or random_peer_id()
+        self.request_handlers: Dict[str, RequestHandler] = {}
+        self.subscriptions: Dict[str, GossipHandler] = {}
+        self._tasks: Set[asyncio.Task] = set()
+        hub.register(self)
+
+    # reqresp ----------------------------------------------------------
+
+    def handle(self, protocol_id: str, handler: RequestHandler) -> None:
+        self.request_handlers[protocol_id] = handler
+
+    async def request(self, to_peer: str, protocol_id: str, data: bytes) -> bytes:
+        return await self.hub.request(self.peer_id, to_peer, protocol_id, data)
+
+    # gossip -----------------------------------------------------------
+
+    def subscribe(self, topic: str, handler: GossipHandler) -> None:
+        self.subscriptions[topic] = handler
+
+    def unsubscribe(self, topic: str) -> None:
+        self.subscriptions.pop(topic, None)
+
+    async def publish(self, topic: str, message: bytes) -> int:
+        return await self.hub.publish(self.peer_id, topic, message)
+
+    def deliver(self, from_peer: str, topic: str, message: bytes) -> None:
+        handler = self.subscriptions.get(topic)
+        if handler is None:
+            return
+        task = asyncio.ensure_future(handler(from_peer, topic, message))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    def close(self) -> None:
+        self.hub.unregister(self.peer_id)
+        for t in self._tasks:
+            t.cancel()

@@ -450,6 +450,11 @@ class ContainerMeta(type):
                 fields[fname] = ftype
             elif isinstance(ftype, ContainerMeta):
                 fields[fname] = ftype  # nested container class doubles as type
+            elif isinstance(ftype, str) and not fname.startswith("_"):
+                raise TypeError(
+                    f"{name}.{fname}: annotation is a string — the defining "
+                    "module must NOT use `from __future__ import annotations`"
+                )
         cls._fields_ = fields
         return cls
 

@@ -1,0 +1,86 @@
+"""Range sync (reference: beacon-node/src/sync/range/range.ts RangeSync +
+sync/sync.ts BeaconSync orchestration, batches of EPOCHS_PER_BATCH=1 epoch,
+retry limits from sync/constants.ts:8-11).
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from enum import Enum
+from typing import List, Optional
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+from lodestar_tpu.network.peers import PeerAction
+
+EPOCHS_PER_BATCH = 1  # sync/constants.ts:41
+MAX_BATCH_DOWNLOAD_ATTEMPTS = 5  # sync/constants.ts
+MAX_BATCH_PROCESSING_ATTEMPTS = 3
+
+
+class SyncState(str, Enum):
+    Stalled = "Stalled"
+    SyncingFinalized = "SyncingFinalized"
+    SyncingHead = "SyncingHead"
+    Synced = "Synced"
+
+
+@dataclass
+class SyncResult:
+    imported: int
+    head_slot: int
+    state: SyncState
+
+
+class RangeSync:
+    """Pull batches from best peers and drive them through the chain's
+    block pipeline until caught up with the peers' head."""
+
+    def __init__(self, network, chain):
+        self.network = network
+        self.chain = chain
+
+    def _target_slot(self) -> int:
+        best = 0
+        for pid in self.network.peer_manager.connected_peers():
+            info = self.network.peer_manager.peers[pid]
+            if info.status is not None:
+                best = max(best, info.status.head_slot)
+        return best
+
+    async def sync(self) -> SyncResult:
+        imported = 0
+        batch_slots = EPOCHS_PER_BATCH * _p.SLOTS_PER_EPOCH
+        while True:
+            head_slot = self.chain.fork_choice.get_head().slot
+            target = self._target_slot()
+            if head_slot >= target:
+                return SyncResult(imported, head_slot, SyncState.Synced)
+            start = head_slot + 1
+            count = min(batch_slots, target - head_slot)
+            blocks = await self._download_batch(start, count)
+            if not blocks:
+                return SyncResult(imported, head_slot, SyncState.Stalled)
+            for block in blocks:
+                try:
+                    await self.chain.process_block(block)
+                    imported += 1
+                except ValueError:
+                    # invalid segment: penalize the serving peers and stop
+                    for pid in self.network.peer_manager.best_peers(start):
+                        self.network.peer_manager.scores.apply_action(
+                            pid, PeerAction.MidToleranceError
+                        )
+                    return SyncResult(imported, head_slot, SyncState.Stalled)
+
+    async def _download_batch(self, start: int, count: int) -> Optional[List]:
+        peers = self.network.peer_manager.best_peers(min_head_slot=start)
+        attempts = 0
+        for pid in peers * MAX_BATCH_DOWNLOAD_ATTEMPTS:
+            if attempts >= MAX_BATCH_DOWNLOAD_ATTEMPTS:
+                break
+            attempts += 1
+            try:
+                return await self.network.blocks_by_range(pid, start, count)
+            except Exception:
+                continue
+        return None

@@ -1,0 +1,45 @@
+"""Unknown-block sync (reference: beacon-node/src/sync/unknownBlock.ts):
+fetch a gossip block's missing ancestors by root and import the chain
+forward."""
+from __future__ import annotations
+
+from typing import List
+
+from lodestar_tpu.types import ssz
+
+MAX_ANCESTOR_DEPTH = 32
+
+
+class UnknownBlockSync:
+    def __init__(self, network, chain):
+        self.network = network
+        self.chain = chain
+
+    async def resolve(self, signed_block) -> List[bytes]:
+        """Walk parents by root until a known ancestor, then import the
+        chain oldest-first (incl. the original block).  Returns imported
+        roots in order."""
+        pending = [signed_block]
+        parent = bytes(signed_block.message.parent_root)
+        depth = 0
+        while not self.chain.fork_choice.has_block("0x" + parent.hex()):
+            depth += 1
+            if depth > MAX_ANCESTOR_DEPTH:
+                raise ValueError("ancestor chain too deep")
+            fetched = None
+            for pid in self.network.peer_manager.connected_peers():
+                try:
+                    got = await self.network.blocks_by_root(pid, [parent])
+                    if got:
+                        fetched = got[0]
+                        break
+                except Exception:
+                    continue
+            if fetched is None:
+                raise ValueError(f"cannot resolve ancestor {parent.hex()}")
+            pending.append(fetched)
+            parent = bytes(fetched.message.parent_root)
+        roots = []
+        for block in reversed(pending):
+            roots.append(await self.chain.process_block(block))
+        return roots

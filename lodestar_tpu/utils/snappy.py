@@ -1,0 +1,224 @@
+"""Snappy codec: raw block format (gossip messages) and framed format
+(reqresp streams) — the role the reference fills with C snappy bindings
+(@chainsafe/snappy-stream, snappyjs; SURVEY §2.3).
+
+Decompressor implements the full Snappy spec (literals + all three copy
+element kinds).  The compressor emits literal-only blocks: always valid
+Snappy (the format permits arbitrary literal chunking), trading ratio for
+simplicity — wire-compatible with any conformant peer.  The framed format
+implements the official framing spec with masked CRC-32C checksums.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Tuple
+
+# ---------------------------------------------------------------------------
+# varint
+# ---------------------------------------------------------------------------
+
+
+def _write_uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_uvarint(data: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+# ---------------------------------------------------------------------------
+# raw block format
+# ---------------------------------------------------------------------------
+
+_MAX_LITERAL = 60  # tag-encoded literal lengths 1..60
+
+
+def compress(data: bytes) -> bytes:
+    """Literal-only Snappy block (valid per format spec §2.1)."""
+    out = bytearray(_write_uvarint(len(data)))
+    pos = 0
+    n = len(data)
+    while pos < n:
+        chunk = data[pos : pos + 65536]
+        length = len(chunk)
+        if length <= _MAX_LITERAL:
+            out.append((length - 1) << 2)
+        elif length < (1 << 8):
+            out.append(60 << 2)
+            out.append(length - 1)
+        else:
+            out.append(61 << 2)
+            out += struct.pack("<H", length - 1)
+        out += chunk
+        pos += length
+    return bytes(out)
+
+
+def decompress(data: bytes) -> bytes:
+    expected_len, pos = _read_uvarint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                if pos + extra > n:
+                    raise ValueError("truncated literal length")
+                length = int.from_bytes(data[pos : pos + extra], "little") + 1
+                pos += extra
+            if pos + length > n:
+                raise ValueError("truncated literal")
+            out += data[pos : pos + length]
+            pos += length
+            continue
+        if kind == 1:  # copy, 1-byte offset
+            length = ((tag >> 2) & 0x7) + 4
+            if pos >= n:
+                raise ValueError("truncated copy-1")
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:  # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            if pos + 2 > n:
+                raise ValueError("truncated copy-2")
+            offset = struct.unpack_from("<H", data, pos)[0]
+            pos += 2
+        else:  # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            if pos + 4 > n:
+                raise ValueError("truncated copy-4")
+            offset = struct.unpack_from("<I", data, pos)[0]
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError("bad copy offset")
+        # overlapping copies are byte-at-a-time semantics
+        start = len(out) - offset
+        for i in range(length):
+            out.append(out[start + i])
+    if len(out) != expected_len:
+        raise ValueError(f"length mismatch {len(out)} != {expected_len}")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# CRC-32C (Castagnoli), masked per the framing spec
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE: List[int] = []
+
+
+def _crc_table() -> List[int]:
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            tbl.append(c)
+        _CRC_TABLE = tbl
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    tbl = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = tbl[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    """mask(crc) = rotr15(crc) + 0xa282ead8 (framing spec §3)."""
+    c = crc32c(data)
+    return ((((c >> 15) | (c << 17)) & 0xFFFFFFFF) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# framed format (reqresp streams)
+# ---------------------------------------------------------------------------
+
+STREAM_IDENTIFIER = b"\xff\x06\x00\x00sNaPpY"
+_CHUNK_COMPRESSED = 0x00
+_CHUNK_UNCOMPRESSED = 0x01
+_MAX_FRAME_DATA = 65536
+
+
+def frame_compress(data: bytes) -> bytes:
+    """Framed snappy: stream id + one chunk per <=64KiB of input."""
+    out = bytearray(STREAM_IDENTIFIER)
+    for pos in range(0, len(data), _MAX_FRAME_DATA) or [0]:
+        chunk = data[pos : pos + _MAX_FRAME_DATA]
+        body = struct.pack("<I", _masked_crc(chunk)) + compress(chunk)
+        if len(body) >= len(chunk) + 4:
+            body = struct.pack("<I", _masked_crc(chunk)) + chunk
+            kind = _CHUNK_UNCOMPRESSED
+        else:
+            kind = _CHUNK_COMPRESSED
+        out += bytes([kind]) + len(body).to_bytes(3, "little") + body
+    if not data:
+        body = struct.pack("<I", _masked_crc(b"")) + compress(b"")
+        out += bytes([_CHUNK_COMPRESSED]) + len(body).to_bytes(3, "little") + body
+    return bytes(out)
+
+
+def frame_decompress(data: bytes) -> bytes:
+    pos = 0
+    out = bytearray()
+    seen_stream_id = False
+    while pos < len(data):
+        if pos + 4 > len(data):
+            raise ValueError("truncated frame header")
+        kind = data[pos]
+        length = int.from_bytes(data[pos + 1 : pos + 4], "little")
+        pos += 4
+        if pos + length > len(data):
+            raise ValueError("truncated frame body")
+        body = data[pos : pos + length]
+        pos += length
+        if kind == 0xFF:
+            if body != STREAM_IDENTIFIER[4:]:
+                raise ValueError("bad stream identifier")
+            seen_stream_id = True
+            continue
+        if not seen_stream_id:
+            raise ValueError("missing stream identifier")
+        if kind == _CHUNK_COMPRESSED:
+            crc = struct.unpack("<I", body[:4])[0]
+            chunk = decompress(body[4:])
+        elif kind == _CHUNK_UNCOMPRESSED:
+            crc = struct.unpack("<I", body[:4])[0]
+            chunk = body[4:]
+        elif 0x80 <= kind <= 0xFD:
+            continue  # skippable padding
+        else:
+            raise ValueError(f"unknown chunk kind {kind:#x}")
+        if _masked_crc(chunk) != crc:
+            raise ValueError("frame checksum mismatch")
+        out += chunk
+    return bytes(out)
