@@ -1,0 +1,124 @@
+"""Beacon API HTTP client (reference: packages/api getClient fetch client)
+— the seam the validator client uses to talk to the beacon node.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import aiohttp
+
+from lodestar_tpu.ssz.json import from_json, to_json
+from lodestar_tpu.types import ssz
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class ApiClient:
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def _ses(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    async def _get(self, path: str, **params):
+        ses = await self._ses()
+        async with ses.get(self.base_url + path, params=params or None) as resp:
+            body = await resp.json()
+            if resp.status >= 400:
+                raise ApiError(resp.status, body.get("message", ""))
+            return body
+
+    async def _post(self, path: str, payload):
+        ses = await self._ses()
+        async with ses.post(self.base_url + path, json=payload) as resp:
+            if resp.status >= 400:
+                try:
+                    body = await resp.json()
+                    msg = body.get("message", "")
+                except Exception:
+                    msg = await resp.text()
+                raise ApiError(resp.status, msg)
+            return await resp.json() if resp.content_type == "application/json" else {}
+
+    # beacon -----------------------------------------------------------
+
+    async def get_genesis(self) -> dict:
+        return (await self._get("/eth/v1/beacon/genesis"))["data"]
+
+    async def get_validators(self, state_id: str = "head") -> List[dict]:
+        return (await self._get(f"/eth/v1/beacon/states/{state_id}/validators"))["data"]
+
+    async def get_block_root(self, block_id: str = "head") -> bytes:
+        data = (await self._get(f"/eth/v1/beacon/blocks/{block_id}/root"))["data"]
+        return bytes.fromhex(data["root"][2:])
+
+    async def publish_block(self, signed_block) -> None:
+        await self._post(
+            "/eth/v1/beacon/blocks", to_json(ssz.phase0.SignedBeaconBlock, signed_block)
+        )
+
+    async def submit_pool_attestations(self, atts) -> None:
+        await self._post(
+            "/eth/v1/beacon/pool/attestations",
+            [to_json(ssz.phase0.Attestation, a) for a in atts],
+        )
+
+    # node -------------------------------------------------------------
+
+    async def get_syncing(self) -> dict:
+        return (await self._get("/eth/v1/node/syncing"))["data"]
+
+    async def get_version(self) -> str:
+        return (await self._get("/eth/v1/node/version"))["data"]["version"]
+
+    # validator --------------------------------------------------------
+
+    async def get_proposer_duties(self, epoch: int) -> List[dict]:
+        return (await self._get(f"/eth/v1/validator/duties/proposer/{epoch}"))["data"]
+
+    async def get_attester_duties(self, epoch: int, indices: List[int]) -> List[dict]:
+        body = await self._post(
+            f"/eth/v1/validator/duties/attester/{epoch}", [str(i) for i in indices]
+        )
+        return body["data"]
+
+    async def produce_block(self, slot: int, randao_reveal: bytes, graffiti: str = ""):
+        body = await self._get(
+            f"/eth/v2/validator/blocks/{slot}",
+            randao_reveal="0x" + randao_reveal.hex(),
+            graffiti=graffiti,
+        )
+        return from_json(ssz.phase0.BeaconBlock, body["data"])
+
+    async def produce_attestation_data(self, slot: int, committee_index: int):
+        body = await self._get(
+            "/eth/v1/validator/attestation_data",
+            slot=str(slot),
+            committee_index=str(committee_index),
+        )
+        return from_json(ssz.phase0.AttestationData, body["data"])
+
+    async def get_aggregate(self, slot: int, data_root: bytes):
+        body = await self._get(
+            "/eth/v1/validator/aggregate_attestation",
+            slot=str(slot),
+            attestation_data_root="0x" + data_root.hex(),
+        )
+        return from_json(ssz.phase0.Attestation, body["data"])
+
+    async def submit_aggregate_and_proofs(self, signed_aggs) -> None:
+        await self._post(
+            "/eth/v1/validator/aggregate_and_proofs",
+            [to_json(ssz.phase0.SignedAggregateAndProof, s) for s in signed_aggs],
+        )

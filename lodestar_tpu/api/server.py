@@ -1,0 +1,666 @@
+"""Beacon REST API server (reference: packages/api route definitions +
+packages/beacon-node/src/api/{impl,rest} — fastify there, aiohttp here).
+
+Implements the Eth Beacon API surface the validator client and tooling
+consume: beacon (genesis/states/headers/blocks/pools), node, config,
+validator duties + production, debug, events (SSE), plus the lodestar
+namespace.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ACTIVE_PRESET_NAME
+from lodestar_tpu.ssz.json import from_json, to_json
+from lodestar_tpu.state_transition.util.misc import (
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    get_block_root_at_slot,
+)
+from lodestar_tpu.types import ssz
+from lodestar_tpu.chain.chain import ChainEvent
+
+VERSION = "lodestar-tpu/0.2.0"
+
+
+def _ok(data, **extra) -> web.Response:
+    return web.json_response({"data": data, **extra})
+
+
+def _err(code: int, message: str) -> web.Response:
+    return web.json_response({"code": code, "message": message}, status=code)
+
+
+class BeaconRestApiServer:
+    """chain+db+network -> HTTP (BeaconRestApiServer role)."""
+
+    def __init__(self, chain, db, network=None, sync=None):
+        self.chain = chain
+        self.db = db
+        self.network = network
+        self.sync = sync
+        self.app = web.Application()
+        self._event_queues: list = []
+        self._routes()
+        self._runner: Optional[web.AppRunner] = None
+        chain.on(ChainEvent.block, self._on_block_event)
+        chain.on(ChainEvent.head, self._on_head_event)
+        chain.on(ChainEvent.finalized, self._on_finalized_event)
+
+    # ------------------------------------------------------------------
+
+    def _routes(self) -> None:
+        r = self.app.router
+        # beacon
+        r.add_get("/eth/v1/beacon/genesis", self.get_genesis)
+        r.add_get("/eth/v1/beacon/states/{state_id}/root", self.get_state_root)
+        r.add_get("/eth/v1/beacon/states/{state_id}/fork", self.get_state_fork)
+        r.add_get(
+            "/eth/v1/beacon/states/{state_id}/finality_checkpoints",
+            self.get_finality_checkpoints,
+        )
+        r.add_get("/eth/v1/beacon/states/{state_id}/validators", self.get_validators)
+        r.add_get(
+            "/eth/v1/beacon/states/{state_id}/validators/{validator_id}",
+            self.get_validator,
+        )
+        r.add_get("/eth/v1/beacon/headers/{block_id}", self.get_header)
+        r.add_get("/eth/v2/beacon/blocks/{block_id}", self.get_block)
+        r.add_get("/eth/v1/beacon/blocks/{block_id}/root", self.get_block_root)
+        r.add_post("/eth/v1/beacon/blocks", self.post_block)
+        r.add_post("/eth/v1/beacon/pool/attestations", self.post_pool_attestations)
+        r.add_post("/eth/v1/beacon/pool/voluntary_exits", self.post_pool_exit)
+        # node
+        r.add_get("/eth/v1/node/version", self.get_version)
+        r.add_get("/eth/v1/node/health", self.get_health)
+        r.add_get("/eth/v1/node/syncing", self.get_syncing)
+        r.add_get("/eth/v1/node/identity", self.get_identity)
+        r.add_get("/eth/v1/node/peers", self.get_peers)
+        # config
+        r.add_get("/eth/v1/config/spec", self.get_spec)
+        r.add_get("/eth/v1/config/deposit_contract", self.get_deposit_contract)
+        # validator
+        r.add_get("/eth/v1/validator/duties/proposer/{epoch}", self.get_proposer_duties)
+        r.add_post("/eth/v1/validator/duties/attester/{epoch}", self.post_attester_duties)
+        r.add_get("/eth/v2/validator/blocks/{slot}", self.produce_block)
+        r.add_get("/eth/v1/validator/attestation_data", self.produce_attestation_data)
+        r.add_get("/eth/v1/validator/aggregate_attestation", self.get_aggregate)
+        r.add_post("/eth/v1/validator/aggregate_and_proofs", self.post_aggregate_and_proofs)
+        # events + debug
+        r.add_get("/eth/v1/events", self.get_events)
+        r.add_get("/eth/v1/debug/beacon/heads", self.get_debug_heads)
+
+    # ------------------------------------------------------------------
+    # state helpers
+    # ------------------------------------------------------------------
+
+    def _resolve_state(self, state_id: str):
+        if state_id in ("head", "justified", "finalized"):
+            st = self.chain.get_head_state()
+            return st
+        if state_id.startswith("0x"):
+            # by state root: search cache
+            for root, cached in self.chain.state_cache._map.items():
+                if cached.hash_tree_root().hex() == state_id[2:]:
+                    return cached
+            return None
+        # by slot
+        try:
+            slot = int(state_id)
+        except ValueError:
+            return None
+        st = self.chain.get_head_state()
+        return st if st.state.slot == slot else None
+
+    # ------------------------------------------------------------------
+    # beacon handlers
+    # ------------------------------------------------------------------
+
+    async def get_genesis(self, request):
+        st = self.chain.get_head_state().state
+        return _ok(
+            {
+                "genesis_time": str(st.genesis_time),
+                "genesis_validators_root": "0x"
+                + bytes(st.genesis_validators_root).hex(),
+                "genesis_fork_version": "0x"
+                + self.chain.cfg.GENESIS_FORK_VERSION.hex(),
+            }
+        )
+
+    async def get_state_root(self, request):
+        st = self._resolve_state(request.match_info["state_id"])
+        if st is None:
+            return _err(404, "state not found")
+        return _ok({"root": "0x" + st.hash_tree_root().hex()})
+
+    async def get_state_fork(self, request):
+        st = self._resolve_state(request.match_info["state_id"])
+        if st is None:
+            return _err(404, "state not found")
+        return _ok(to_json(ssz.phase0.Fork, st.state.fork))
+
+    async def get_finality_checkpoints(self, request):
+        st = self._resolve_state(request.match_info["state_id"])
+        if st is None:
+            return _err(404, "state not found")
+        s = st.state
+        return _ok(
+            {
+                "previous_justified": to_json(
+                    ssz.phase0.Checkpoint, s.previous_justified_checkpoint
+                ),
+                "current_justified": to_json(
+                    ssz.phase0.Checkpoint, s.current_justified_checkpoint
+                ),
+                "finalized": to_json(ssz.phase0.Checkpoint, s.finalized_checkpoint),
+            }
+        )
+
+    def _validator_status(self, v, epoch: int) -> str:
+        from lodestar_tpu.params import FAR_FUTURE_EPOCH
+
+        if epoch < v.activation_eligibility_epoch:
+            return "pending_initialized"
+        if epoch < v.activation_epoch:
+            return "pending_queued"
+        if epoch < v.exit_epoch:
+            return "active_slashed" if v.slashed else "active_ongoing"
+        if epoch < v.withdrawable_epoch:
+            return "exited_slashed" if v.slashed else "exited_unslashed"
+        return "withdrawal_possible"
+
+    async def get_validators(self, request):
+        st = self._resolve_state(request.match_info["state_id"])
+        if st is None:
+            return _err(404, "state not found")
+        s = st.state
+        epoch = compute_epoch_at_slot(s.slot)
+        out = []
+        for i, v in enumerate(s.validators):
+            out.append(
+                {
+                    "index": str(i),
+                    "balance": str(s.balances[i]),
+                    "status": self._validator_status(v, epoch),
+                    "validator": to_json(ssz.phase0.Validator, v),
+                }
+            )
+        return _ok(out)
+
+    async def get_validator(self, request):
+        st = self._resolve_state(request.match_info["state_id"])
+        if st is None:
+            return _err(404, "state not found")
+        vid = request.match_info["validator_id"]
+        s = st.state
+        if vid.startswith("0x"):
+            pk = bytes.fromhex(vid[2:])
+            index = st.epoch_ctx.pubkey2index.get(pk)
+        else:
+            index = int(vid)
+        if index is None or index >= len(s.validators):
+            return _err(404, "validator not found")
+        v = s.validators[index]
+        return _ok(
+            {
+                "index": str(index),
+                "balance": str(s.balances[index]),
+                "status": self._validator_status(v, compute_epoch_at_slot(s.slot)),
+                "validator": to_json(ssz.phase0.Validator, v),
+            }
+        )
+
+    def _resolve_block(self, block_id: str):
+        if block_id == "head":
+            return self.db.block.get(self.chain.head_root)
+        if block_id.startswith("0x"):
+            return self.db.block.get(bytes.fromhex(block_id[2:]))
+        try:
+            slot = int(block_id)
+        except ValueError:
+            return None
+        node = self.chain.fork_choice.proto_array.get_ancestor_at_or_before_slot(
+            "0x" + self.chain.head_root.hex(), slot
+        )
+        if node is not None and node.slot == slot:
+            return self.db.block.get(bytes.fromhex(node.block_root[2:]))
+        return self.db.block_archive.get(slot)
+
+    async def get_block(self, request):
+        blk = self._resolve_block(request.match_info["block_id"])
+        if blk is None:
+            return _err(404, "block not found")
+        return _ok(
+            to_json(ssz.phase0.SignedBeaconBlock, blk),
+            version="phase0",
+            execution_optimistic=False,
+        )
+
+    async def get_block_root(self, request):
+        blk = self._resolve_block(request.match_info["block_id"])
+        if blk is None:
+            return _err(404, "block not found")
+        root = ssz.phase0.BeaconBlock.hash_tree_root(blk.message)
+        return _ok({"root": "0x" + root.hex()})
+
+    async def get_header(self, request):
+        blk = self._resolve_block(request.match_info["block_id"])
+        if blk is None:
+            return _err(404, "block not found")
+        m = blk.message
+        root = ssz.phase0.BeaconBlock.hash_tree_root(m)
+        body_t = type(m)._fields_["body"]
+        header = ssz.phase0.BeaconBlockHeader(
+            slot=m.slot,
+            proposer_index=m.proposer_index,
+            parent_root=bytes(m.parent_root),
+            state_root=bytes(m.state_root),
+            body_root=body_t.hash_tree_root(m.body),
+        )
+        return _ok(
+            {
+                "root": "0x" + root.hex(),
+                "canonical": True,
+                "header": {
+                    "message": to_json(ssz.phase0.BeaconBlockHeader, header),
+                    "signature": "0x" + bytes(blk.signature).hex(),
+                },
+            }
+        )
+
+    async def post_block(self, request):
+        body = await request.json()
+        signed = from_json(ssz.phase0.SignedBeaconBlock, body)
+        try:
+            await self.chain.process_block(signed)
+        except ValueError as e:
+            return _err(400, str(e))
+        if self.network is not None:
+            await self.network.publish_block(signed)
+        return web.json_response({}, status=200)
+
+    async def post_pool_attestations(self, request):
+        body = await request.json()
+        failures = []
+        for i, att_json in enumerate(body):
+            att = from_json(ssz.phase0.Attestation, att_json)
+            try:
+                from lodestar_tpu.chain.validation import validate_gossip_attestation
+
+                indices = await validate_gossip_attestation(self.chain, att)
+                self.chain.attestation_pool.add(att)
+                self.chain.fork_choice.on_attestation(
+                    indices,
+                    "0x" + bytes(att.data.beacon_block_root).hex(),
+                    att.data.target.epoch,
+                )
+                if self.network is not None:
+                    from lodestar_tpu.chain.validation import (
+                        compute_subnet_for_attestation,
+                    )
+
+                    cps = self.chain.get_head_state().epoch_ctx.get_committee_count_per_slot(
+                        att.data.target.epoch
+                    )
+                    subnet = compute_subnet_for_attestation(
+                        cps, att.data.slot, att.data.index
+                    )
+                    await self.network.publish_attestation(att, subnet)
+            except Exception as e:
+                failures.append({"index": i, "message": str(e)})
+        if failures:
+            return web.json_response(
+                {"code": 400, "message": "some failed", "failures": failures},
+                status=400,
+            )
+        return web.json_response({}, status=200)
+
+    async def post_pool_exit(self, request):
+        body = await request.json()
+        exit_ = from_json(ssz.phase0.SignedVoluntaryExit, body)
+        self.chain.op_pool.add_voluntary_exit(exit_)
+        return web.json_response({}, status=200)
+
+    # ------------------------------------------------------------------
+    # node / config
+    # ------------------------------------------------------------------
+
+    async def get_version(self, request):
+        return _ok({"version": VERSION})
+
+    async def get_health(self, request):
+        return web.Response(status=200)
+
+    async def get_syncing(self, request):
+        head = self.chain.fork_choice.get_head()
+        current = self.chain.clock.current_slot
+        distance = max(0, current - head.slot)
+        return _ok(
+            {
+                "head_slot": str(head.slot),
+                "sync_distance": str(distance),
+                "is_syncing": distance > 1,
+                "is_optimistic": False,
+                "el_offline": self.chain.execution_engine is None,
+            }
+        )
+
+    async def get_identity(self, request):
+        pid = self.network.peer_id if self.network else "unknown"
+        return _ok(
+            {
+                "peer_id": pid,
+                "enr": "",
+                "p2p_addresses": [],
+                "discovery_addresses": [],
+                "metadata": {"seq_number": "0", "attnets": "0x" + "00" * 8},
+            }
+        )
+
+    async def get_peers(self, request):
+        peers = []
+        if self.network:
+            for pid in self.network.peer_manager.connected_peers():
+                peers.append(
+                    {
+                        "peer_id": pid,
+                        "state": "connected",
+                        "direction": "outbound",
+                        "last_seen_p2p_address": "",
+                        "enr": "",
+                    }
+                )
+        return _ok(peers, meta={"count": len(peers)})
+
+    async def get_spec(self, request):
+        from dataclasses import fields as dc_fields
+
+        out = {}
+        for f in dc_fields(type(self.chain.cfg)):
+            v = getattr(self.chain.cfg, f.name)
+            out[f.name] = "0x" + v.hex() if isinstance(v, bytes) else str(v)
+        for name in dir(_p):
+            if name.isupper():
+                out[name] = str(getattr(_p, name))
+        out["PRESET_BASE"] = ACTIVE_PRESET_NAME
+        return _ok(out)
+
+    async def get_deposit_contract(self, request):
+        return _ok(
+            {
+                "chain_id": str(self.chain.cfg.DEPOSIT_CHAIN_ID),
+                "address": "0x" + self.chain.cfg.DEPOSIT_CONTRACT_ADDRESS.hex(),
+            }
+        )
+
+    # ------------------------------------------------------------------
+    # validator handlers
+    # ------------------------------------------------------------------
+
+    def _state_for_epoch(self, epoch: int):
+        """Head state advanced (dirty-clone) into `epoch` if it has already
+        started on the clock but no block has arrived yet (the reference
+        regens the epoch-start state for duties)."""
+        from lodestar_tpu.state_transition import process_slots
+
+        st = self.chain.get_head_state()
+        if epoch == st.epoch_ctx.epoch:
+            return st
+        start = compute_start_slot_at_epoch(epoch)
+        if st.state.slot < start and epoch <= compute_epoch_at_slot(
+            max(self.chain.clock.current_slot, start)
+        ):
+            advanced = st.clone()
+            process_slots(advanced, start)
+            return advanced
+        return st
+
+    async def get_proposer_duties(self, request):
+        epoch = int(request.match_info["epoch"])
+        st = self._state_for_epoch(epoch)
+        if epoch != st.epoch_ctx.epoch:
+            return _err(400, f"epoch {epoch} not current")
+        duties = []
+        start = compute_start_slot_at_epoch(epoch)
+        for i, proposer in enumerate(st.epoch_ctx.proposers):
+            pk = bytes(st.state.validators[proposer].pubkey)
+            duties.append(
+                {
+                    "pubkey": "0x" + pk.hex(),
+                    "validator_index": str(proposer),
+                    "slot": str(start + i),
+                }
+            )
+        return _ok(duties, dependent_root="0x" + self.chain.head_root.hex())
+
+    async def post_attester_duties(self, request):
+        epoch = int(request.match_info["epoch"])
+        indices = [int(i) for i in await request.json()]
+        st = self._state_for_epoch(epoch)
+        try:
+            shuffling = st.epoch_ctx.get_shuffling(epoch)
+        except ValueError:
+            return _err(400, f"epoch {epoch} out of range")
+        duties = []
+        start = compute_start_slot_at_epoch(epoch)
+        for slot in range(start, start + _p.SLOTS_PER_EPOCH):
+            for cidx in range(shuffling.committees_per_slot):
+                committee = shuffling.committee(slot, cidx)
+                for pos, vi in enumerate(committee):
+                    if int(vi) in indices:
+                        pk = bytes(st.state.validators[int(vi)].pubkey)
+                        duties.append(
+                            {
+                                "pubkey": "0x" + pk.hex(),
+                                "validator_index": str(int(vi)),
+                                "committee_index": str(cidx),
+                                "committee_length": str(len(committee)),
+                                "committees_at_slot": str(shuffling.committees_per_slot),
+                                "validator_committee_index": str(pos),
+                                "slot": str(slot),
+                            }
+                        )
+        return _ok(duties, dependent_root="0x" + self.chain.head_root.hex())
+
+    async def produce_block(self, request):
+        slot = int(request.match_info["slot"])
+        randao_reveal = bytes.fromhex(
+            request.query.get("randao_reveal", "0x" + "00" * 96)[2:]
+        )
+        graffiti = request.query.get("graffiti", "")
+        block = await self._produce_block(slot, randao_reveal, graffiti)
+        return _ok(
+            to_json(ssz.phase0.BeaconBlock, block), version="phase0", execution_payload_blinded=False
+        )
+
+    async def _produce_block(self, slot, randao_reveal, graffiti=""):
+        """produceBlockWrapper + produceBlockBody in miniature."""
+        from lodestar_tpu.state_transition import process_slots, state_transition
+
+        head_state = self.chain.get_head_state()
+        pre = head_state.clone()
+        if pre.state.slot < slot:
+            process_slots(pre, slot)
+        proposer = pre.epoch_ctx.get_beacon_proposer(slot)
+        atts = self.chain.aggregated_attestation_pool.get_attestations_for_block(slot)
+        prop_slash, att_slash, exits = self.chain.op_pool.get_slashings_and_exits(
+            pre.state
+        )
+        g = graffiti.encode()[:32].ljust(32, b"\x00") if isinstance(graffiti, str) else graffiti
+        body = ssz.phase0.BeaconBlockBody(
+            randao_reveal=randao_reveal,
+            eth1_data=pre.state.eth1_data,
+            graffiti=g,
+            proposer_slashings=prop_slash,
+            attester_slashings=att_slash,
+            attestations=atts,
+            voluntary_exits=exits,
+        )
+        hdr = head_state.state.latest_block_header
+        parent_hdr = ssz.phase0.BeaconBlockHeader(
+            slot=hdr.slot, proposer_index=hdr.proposer_index,
+            parent_root=bytes(hdr.parent_root), state_root=bytes(hdr.state_root),
+            body_root=bytes(hdr.body_root),
+        )
+        if bytes(parent_hdr.state_root) == b"\x00" * 32:
+            parent_hdr.state_root = head_state.hash_tree_root()
+        block = ssz.phase0.BeaconBlock(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=ssz.phase0.BeaconBlockHeader.hash_tree_root(parent_hdr),
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        trial = ssz.phase0.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
+        post = state_transition(
+            self.chain.get_head_state(), trial,
+            verify_state_root=False, verify_proposer=False, verify_signatures=False,
+        )
+        block.state_root = post.hash_tree_root()
+        return block
+
+    async def produce_attestation_data(self, request):
+        slot = int(request.query["slot"])
+        committee_index = int(request.query["committee_index"])
+        st = self.chain.get_head_state()
+        s = st.state
+        epoch = compute_epoch_at_slot(slot)
+        start = compute_start_slot_at_epoch(epoch)
+        head_root = self.chain.head_root
+        if start >= s.slot:
+            target_root = head_root
+        else:
+            target_root = get_block_root_at_slot(s, start)
+        data = ssz.phase0.AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=head_root,
+            source=s.current_justified_checkpoint,
+            target=ssz.phase0.Checkpoint(epoch=epoch, root=target_root),
+        )
+        return _ok(to_json(ssz.phase0.AttestationData, data))
+
+    async def get_aggregate(self, request):
+        slot = int(request.query["slot"])
+        data_root = bytes.fromhex(
+            request.query["attestation_data_root"].removeprefix("0x")
+        )
+        agg = self.chain.attestation_pool.get_aggregate(slot, data_root)
+        if agg is None:
+            return _err(404, "no matching aggregate")
+        return _ok(to_json(ssz.phase0.Attestation, agg))
+
+    async def post_aggregate_and_proofs(self, request):
+        body = await request.json()
+        for item in body:
+            signed = from_json(ssz.phase0.SignedAggregateAndProof, item)
+            from lodestar_tpu.chain.validation import (
+                validate_gossip_aggregate_and_proof,
+            )
+
+            try:
+                indices = await validate_gossip_aggregate_and_proof(self.chain, signed)
+            except Exception as e:
+                return _err(400, str(e))
+            agg = signed.message.aggregate
+            self.chain.aggregated_attestation_pool.add(agg)
+            self.chain.fork_choice.on_attestation(
+                indices,
+                "0x" + bytes(agg.data.beacon_block_root).hex(),
+                agg.data.target.epoch,
+            )
+            if self.network is not None:
+                await self.network.publish_aggregate(signed)
+        return web.json_response({}, status=200)
+
+    # ------------------------------------------------------------------
+    # events (SSE) + debug
+    # ------------------------------------------------------------------
+
+    def _on_block_event(self, signed_block, root):
+        self._push_event(
+            "block",
+            {
+                "slot": str(signed_block.message.slot),
+                "block": "0x" + root.hex(),
+                "execution_optimistic": False,
+            },
+        )
+
+    def _on_head_event(self, root):
+        head = self.chain.fork_choice.get_head()
+        self._push_event(
+            "head",
+            {
+                "slot": str(head.slot),
+                "block": "0x" + root.hex(),
+                "state": head.state_root,
+                "epoch_transition": head.slot % _p.SLOTS_PER_EPOCH == 0,
+            },
+        )
+
+    def _on_finalized_event(self, cp):
+        self._push_event(
+            "finalized_checkpoint",
+            {"epoch": str(cp.epoch), "block": cp.root, "state": cp.root},
+        )
+
+    def _push_event(self, topic: str, data: dict) -> None:
+        for queue, topics in self._event_queues:
+            if topic in topics:
+                queue.put_nowait((topic, data))
+
+    async def get_events(self, request):
+        topics = request.query.get("topics", "head,block,finalized_checkpoint").split(",")
+        queue: asyncio.Queue = asyncio.Queue()
+        entry = (queue, topics)
+        self._event_queues.append(entry)
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(request)
+        try:
+            while True:
+                topic, data = await queue.get()
+                payload = f"event: {topic}\ndata: {json.dumps(data)}\n\n"
+                await resp.write(payload.encode())
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            self._event_queues.remove(entry)
+        return resp
+
+    async def get_debug_heads(self, request):
+        heads = []
+        arr = self.chain.fork_choice.proto_array
+        children = {n.parent for n in arr.nodes if n.parent is not None}
+        for i, node in enumerate(arr.nodes):
+            if i not in children:
+                heads.append(
+                    {"root": node.block_root, "slot": str(node.slot),
+                     "execution_optimistic": False}
+                )
+        return _ok(heads)
+
+    # ------------------------------------------------------------------
+
+    async def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        return site._server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()

@@ -1,0 +1,60 @@
+"""SSZ value <-> Beacon-API JSON (reference: @chainsafe/ssz toJson/fromJson
+used by packages/api route codecs): snake_case field names, uints as
+decimal strings, byte vectors/lists as 0x-hex, bitlists/bitvectors as
+0x-hex of their SSZ encoding.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from .core import (
+    BitlistT,
+    BitvectorT,
+    Boolean,
+    ByteListT,
+    ByteVectorT,
+    ContainerMeta,
+    ListT,
+    SszType,
+    Uint,
+    VectorT,
+)
+
+
+def to_json(ssz_type, value) -> Any:
+    if isinstance(ssz_type, Uint):
+        return str(int(value))
+    if isinstance(ssz_type, Boolean):
+        return bool(value)
+    if isinstance(ssz_type, (ByteVectorT, ByteListT)):
+        return "0x" + bytes(value).hex()
+    if isinstance(ssz_type, (BitlistT, BitvectorT)):
+        return "0x" + ssz_type.serialize(value).hex()
+    if isinstance(ssz_type, (ListT, VectorT)):
+        return [to_json(ssz_type.elem, v) for v in value]
+    if isinstance(ssz_type, ContainerMeta):
+        return {
+            name: to_json(ftype, getattr(value, name))
+            for name, ftype in ssz_type._fields_.items()
+        }
+    raise TypeError(f"cannot JSON-encode {ssz_type!r}")
+
+
+def from_json(ssz_type, data: Any):
+    if isinstance(ssz_type, Uint):
+        return int(data)
+    if isinstance(ssz_type, Boolean):
+        return bool(data) if not isinstance(data, str) else data == "true"
+    if isinstance(ssz_type, (ByteVectorT, ByteListT)):
+        return bytes.fromhex(data.removeprefix("0x"))
+    if isinstance(ssz_type, (BitlistT, BitvectorT)):
+        return ssz_type.deserialize(bytes.fromhex(data.removeprefix("0x")))
+    if isinstance(ssz_type, (ListT, VectorT)):
+        return [from_json(ssz_type.elem, v) for v in data]
+    if isinstance(ssz_type, ContainerMeta):
+        kwargs = {}
+        for name, ftype in ssz_type._fields_.items():
+            if name in data:
+                kwargs[name] = from_json(ftype, data[name])
+        return ssz_type(**kwargs)
+    raise TypeError(f"cannot JSON-decode {ssz_type!r}")

@@ -1,0 +1,110 @@
+"""EIP-2335 BLS keystores (reference: the cli's keystore handling,
+packages/cli/src/cmds/validator/ via @chainsafe/bls-keystore).
+
+Supports scrypt and pbkdf2 KDFs with AES-128-CTR, per the spec's test
+vector parameters.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import uuid
+from typing import Optional
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+
+class KeystoreError(Exception):
+    pass
+
+
+def _derive_key(kdf: dict, password: bytes) -> bytes:
+    params = kdf["params"]
+    if kdf["function"] == "scrypt":
+        return hashlib.scrypt(
+            password,
+            salt=bytes.fromhex(params["salt"]),
+            n=params["n"], r=params["r"], p=params["p"],
+            dklen=params["dklen"], maxmem=2**31 - 1,
+        )
+    if kdf["function"] == "pbkdf2":
+        return hashlib.pbkdf2_hmac(
+            params["prf"].replace("hmac-", ""),
+            password,
+            bytes.fromhex(params["salt"]),
+            params["c"],
+            dklen=params["dklen"],
+        )
+    raise KeystoreError(f"unsupported kdf {kdf['function']}")
+
+
+def _aes128ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def _password_bytes(password: str) -> bytes:
+    # EIP-2335: NFKD normalize, strip C0/C1 control codes
+    import unicodedata
+
+    norm = unicodedata.normalize("NFKD", password)
+    return "".join(
+        c for c in norm if not (ord(c) < 0x20 or 0x7F <= ord(c) <= 0x9F)
+    ).encode()
+
+
+def decrypt_keystore(keystore: dict, password: str) -> bytes:
+    crypto = keystore["crypto"]
+    dk = _derive_key(crypto["kdf"], _password_bytes(password))
+    cipher_message = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = hashlib.sha256(dk[16:32] + cipher_message).hexdigest()
+    if checksum != crypto["checksum"]["message"]:
+        raise KeystoreError("invalid password (checksum mismatch)")
+    if crypto["cipher"]["function"] != "aes-128-ctr":
+        raise KeystoreError("unsupported cipher")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    return _aes128ctr(dk[:16], iv, cipher_message)
+
+
+def create_keystore(
+    secret: bytes,
+    password: str,
+    pubkey: Optional[bytes] = None,
+    path: str = "",
+    kdf: str = "scrypt",
+) -> dict:
+    salt = secrets.token_bytes(32)
+    iv = secrets.token_bytes(16)
+    if kdf == "scrypt":
+        kdf_module = {
+            "function": "scrypt",
+            "params": {"dklen": 32, "n": 16384, "r": 8, "p": 1, "salt": salt.hex()},
+            "message": "",
+        }
+    else:
+        kdf_module = {
+            "function": "pbkdf2",
+            "params": {"dklen": 32, "c": 262144, "prf": "hmac-sha256", "salt": salt.hex()},
+            "message": "",
+        }
+    dk = _derive_key(kdf_module, _password_bytes(password))
+    cipher_message = _aes128ctr(dk[:16], iv, secret)
+    checksum = hashlib.sha256(dk[16:32] + cipher_message).hexdigest()
+    return {
+        "version": 4,
+        "uuid": str(uuid.uuid4()),
+        "path": path,
+        "pubkey": pubkey.hex() if pubkey else "",
+        "crypto": {
+            "kdf": kdf_module,
+            "checksum": {"function": "sha256", "params": {}, "message": checksum},
+            "cipher": {
+                "function": "aes-128-ctr",
+                "params": {"iv": iv.hex()},
+                "message": cipher_message.hex(),
+            },
+        },
+    }

@@ -1,0 +1,190 @@
+"""Slashing protection database (reference:
+packages/validator/src/slashingProtection/ — attestation by-target records,
+lower bounds, and min-max surround checks; EIP-3076 interchange format).
+
+Storage: the shared KV controller under the reference's slashing-protection
+buckets (db/schema.ts 20-24).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from lodestar_tpu.db.controller import KvController, MemoryController
+from lodestar_tpu.db.schema import Bucket, encode_key
+
+
+class SlashingProtectionError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class SignedBlockRecord:
+    slot: int
+    signing_root: bytes
+
+
+@dataclass(frozen=True)
+class SignedAttestationRecord:
+    source_epoch: int
+    target_epoch: int
+    signing_root: bytes
+
+
+def _k(bucket: Bucket, pubkey: bytes, suffix: bytes = b"") -> bytes:
+    return encode_key(bucket, pubkey + suffix)
+
+
+class SlashingProtection:
+    def __init__(self, db: Optional[KvController] = None):
+        self.db = db or MemoryController()
+
+    # ------------------------------------------------------------------
+    # blocks
+    # ------------------------------------------------------------------
+
+    def check_and_insert_block_proposal(self, pubkey: bytes, record: SignedBlockRecord) -> None:
+        """Deny re-signing at or below a previously signed slot (different
+        root); idempotent for exact repeats."""
+        key = _k(Bucket.phase0_slashingProtectionBlockBySlot, pubkey,
+                 record.slot.to_bytes(8, "big"))
+        existing = self.db.get(key)
+        if existing is not None:
+            if existing == record.signing_root:
+                return  # same proposal, benign repeat
+            raise SlashingProtectionError(
+                f"double block proposal at slot {record.slot}"
+            )
+        # any earlier-signed slot >= this one means this is a re-org sign
+        lo = _k(Bucket.phase0_slashingProtectionBlockBySlot, pubkey,
+                record.slot.to_bytes(8, "big"))
+        hi = _k(Bucket.phase0_slashingProtectionBlockBySlot, pubkey, b"\xff" * 8)
+        for k in self.db.keys_range(lo, hi, limit=1):
+            if k != key:
+                raise SlashingProtectionError(
+                    f"block slot {record.slot} not above last signed slot"
+                )
+        self.db.put(key, record.signing_root)
+
+    # ------------------------------------------------------------------
+    # attestations
+    # ------------------------------------------------------------------
+
+    def _att_records(self, pubkey: bytes) -> List[SignedAttestationRecord]:
+        lo = _k(Bucket.phase0_slashingProtectionAttestationByTarget, pubkey)
+        hi = _k(Bucket.phase0_slashingProtectionAttestationByTarget, pubkey, b"\xff" * 8)
+        out = []
+        for k, v in self.db.entries_range(lo, hi):
+            target = int.from_bytes(k[-8:], "big")
+            source = int.from_bytes(v[:8], "big")
+            out.append(SignedAttestationRecord(source, target, v[8:]))
+        return out
+
+    def check_and_insert_attestation(
+        self, pubkey: bytes, record: SignedAttestationRecord
+    ) -> None:
+        """EIP-3076 rules: no double vote (same target, different root), no
+        surround in either direction, respect imported lower bounds."""
+        if record.source_epoch > record.target_epoch:
+            raise SlashingProtectionError("source > target")
+        lb = self.db.get(
+            _k(Bucket.phase0_slashingProtectionAttestationLowerBound, pubkey)
+        )
+        if lb is not None:
+            lb_source = int.from_bytes(lb[:8], "big")
+            lb_target = int.from_bytes(lb[8:16], "big")
+            if record.source_epoch < lb_source:
+                raise SlashingProtectionError("source below lower bound")
+            if record.target_epoch <= lb_target:
+                raise SlashingProtectionError("target at/below lower bound")
+        key = _k(
+            Bucket.phase0_slashingProtectionAttestationByTarget, pubkey,
+            record.target_epoch.to_bytes(8, "big"),
+        )
+        existing = self.db.get(key)
+        if existing is not None:
+            if existing[8:] == record.signing_root:
+                return
+            raise SlashingProtectionError(
+                f"double vote at target {record.target_epoch}"
+            )
+        for old in self._att_records(pubkey):
+            if record.source_epoch < old.source_epoch and old.target_epoch < record.target_epoch:
+                raise SlashingProtectionError("attestation surrounds a previous one")
+            if old.source_epoch < record.source_epoch and record.target_epoch < old.target_epoch:
+                raise SlashingProtectionError("attestation is surrounded")
+        self.db.put(
+            key, record.source_epoch.to_bytes(8, "big") + record.signing_root
+        )
+
+    # ------------------------------------------------------------------
+    # EIP-3076 interchange
+    # ------------------------------------------------------------------
+
+    def export_interchange(self, genesis_validators_root: bytes, pubkeys: List[bytes]) -> dict:
+        data = []
+        for pk in pubkeys:
+            blocks = []
+            lo = _k(Bucket.phase0_slashingProtectionBlockBySlot, pk)
+            hi = _k(Bucket.phase0_slashingProtectionBlockBySlot, pk, b"\xff" * 8)
+            for k, v in self.db.entries_range(lo, hi):
+                blocks.append(
+                    {"slot": str(int.from_bytes(k[-8:], "big")),
+                     "signing_root": "0x" + v.hex()}
+                )
+            atts = [
+                {
+                    "source_epoch": str(r.source_epoch),
+                    "target_epoch": str(r.target_epoch),
+                    "signing_root": "0x" + r.signing_root.hex(),
+                }
+                for r in self._att_records(pk)
+            ]
+            data.append(
+                {"pubkey": "0x" + pk.hex(), "signed_blocks": blocks,
+                 "signed_attestations": atts}
+            )
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x" + genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, obj: dict, genesis_validators_root: bytes) -> None:
+        meta = obj["metadata"]
+        gvr = bytes.fromhex(meta["genesis_validators_root"][2:])
+        if gvr != genesis_validators_root:
+            raise SlashingProtectionError("genesis_validators_root mismatch")
+        for entry in obj["data"]:
+            pk = bytes.fromhex(entry["pubkey"][2:])
+            max_slot = -1
+            max_source = -1
+            max_target = -1
+            for b in entry.get("signed_blocks", []):
+                slot = int(b["slot"])
+                root = bytes.fromhex(b.get("signing_root", "0x" + "00" * 32)[2:])
+                self.db.put(
+                    _k(Bucket.phase0_slashingProtectionBlockBySlot, pk,
+                       slot.to_bytes(8, "big")),
+                    root,
+                )
+                max_slot = max(max_slot, slot)
+            for a in entry.get("signed_attestations", []):
+                src, tgt = int(a["source_epoch"]), int(a["target_epoch"])
+                root = bytes.fromhex(a.get("signing_root", "0x" + "00" * 32)[2:])
+                self.db.put(
+                    _k(Bucket.phase0_slashingProtectionAttestationByTarget, pk,
+                       tgt.to_bytes(8, "big")),
+                    src.to_bytes(8, "big") + root,
+                )
+                max_source = max(max_source, src)
+                max_target = max(max_target, tgt)
+            if max_source >= 0:
+                self.db.put(
+                    _k(Bucket.phase0_slashingProtectionAttestationLowerBound, pk),
+                    max(0, max_source).to_bytes(8, "big")
+                    + max(0, max_target).to_bytes(8, "big"),
+                )

@@ -1,0 +1,148 @@
+"""Validator client (reference: packages/validator/src/validator.ts +
+services/{attestationDuties,attestation,block}.ts): duties via the Beacon
+API, signing via ValidatorStore (slashing-protected), submission back over
+the API — a separate process from the node in production, same seam here.
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from lodestar_tpu.api.client import ApiClient
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+from lodestar_tpu.ssz.json import to_json
+from lodestar_tpu.state_transition.util.aggregator import (
+    is_aggregator_from_committee_length,
+)
+from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+from lodestar_tpu.types import ssz
+from .validator_store import ValidatorStore
+
+
+@dataclass
+class AttesterDuty:
+    pubkey: bytes
+    validator_index: int
+    committee_index: int
+    committee_length: int
+    committees_at_slot: int
+    validator_committee_index: int
+    slot: int
+
+
+class Validator:
+    """Drives proposal + attestation duties for its keys against a beacon
+    node API; `run_slot` performs everything a production VC would do in a
+    slot (proposals at slot start, attestations at 1/3 slot, aggregation at
+    2/3 slot — here sequential)."""
+
+    def __init__(self, api: ApiClient, store: ValidatorStore):
+        self.api = api
+        self.store = store
+        self._index_by_pubkey: Dict[bytes, int] = {}
+        self.produced_blocks = 0
+        self.produced_attestations = 0
+        self.produced_aggregates = 0
+
+    async def initialize(self) -> None:
+        """Map pubkeys to validator indices (validator.ts
+        initializeFromBeaconNode / indices service)."""
+        validators = await self.api.get_validators()
+        mine = set(self.store.pubkeys)
+        for item in validators:
+            pk = bytes.fromhex(item["validator"]["pubkey"][2:])
+            if pk in mine:
+                self._index_by_pubkey[pk] = int(item["index"])
+
+    @property
+    def indices(self) -> List[int]:
+        return sorted(self._index_by_pubkey.values())
+
+    # ------------------------------------------------------------------
+
+    async def propose_if_due(self, slot: int) -> Optional[bytes]:
+        epoch = compute_epoch_at_slot(slot)
+        duties = await self.api.get_proposer_duties(epoch)
+        for duty in duties:
+            if int(duty["slot"]) != slot:
+                continue
+            pk = bytes.fromhex(duty["pubkey"][2:])
+            if not self.store.has(pk):
+                continue
+            randao = self.store.sign_randao(pk, slot)
+            block = await self.api.produce_block(slot, randao, graffiti="lodestar-tpu-vc")
+            signed = self.store.sign_block(pk, block)
+            await self.api.publish_block(signed)
+            self.produced_blocks += 1
+            return ssz.phase0.BeaconBlock.hash_tree_root(block)
+        return None
+
+    async def attest(self, slot: int) -> List["ssz.phase0.Attestation"]:
+        epoch = compute_epoch_at_slot(slot)
+        duties = await self._attester_duties(epoch)
+        out = []
+        for duty in duties:
+            if duty.slot != slot:
+                continue
+            data = await self.api.produce_attestation_data(slot, duty.committee_index)
+            att = self.store.sign_attestation(
+                duty.pubkey, data, duty.committee_length, duty.validator_committee_index
+            )
+            out.append((duty, att))
+        if out:
+            await self.api.submit_pool_attestations([a for _, a in out])
+            self.produced_attestations += len(out)
+        return [a for _, a in out]
+
+    async def aggregate_if_due(self, slot: int) -> int:
+        """Aggregation duties (attestation.ts runAttestationTasks part 2 +
+        aggregator selection)."""
+        epoch = compute_epoch_at_slot(slot)
+        duties = await self._attester_duties(epoch)
+        submitted = 0
+        for duty in duties:
+            if duty.slot != slot:
+                continue
+            proof = self.store.sign_selection_proof(duty.pubkey, slot)
+            if not is_aggregator_from_committee_length(duty.committee_length, proof):
+                continue
+            data = await self.api.produce_attestation_data(slot, duty.committee_index)
+            data_root = ssz.phase0.AttestationData.hash_tree_root(data)
+            try:
+                aggregate = await self.api.get_aggregate(slot, data_root)
+            except Exception:
+                continue
+            aap = ssz.phase0.AggregateAndProof(
+                aggregator_index=duty.validator_index,
+                aggregate=aggregate,
+                selection_proof=proof,
+            )
+            signed = self.store.sign_aggregate_and_proof(duty.pubkey, aap)
+            try:
+                await self.api.submit_aggregate_and_proofs([signed])
+                submitted += 1
+            except Exception:
+                continue
+        self.produced_aggregates += submitted
+        return submitted
+
+    async def _attester_duties(self, epoch: int) -> List[AttesterDuty]:
+        raw = await self.api.get_attester_duties(epoch, self.indices)
+        return [
+            AttesterDuty(
+                pubkey=bytes.fromhex(d["pubkey"][2:]),
+                validator_index=int(d["validator_index"]),
+                committee_index=int(d["committee_index"]),
+                committee_length=int(d["committee_length"]),
+                committees_at_slot=int(d["committees_at_slot"]),
+                validator_committee_index=int(d["validator_committee_index"]),
+                slot=int(d["slot"]),
+            )
+            for d in raw
+        ]
+
+    async def run_slot(self, slot: int) -> None:
+        await self.propose_if_due(slot)
+        await self.attest(slot)
+        await self.aggregate_if_due(slot)

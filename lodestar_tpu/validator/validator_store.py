@@ -1,0 +1,135 @@
+"""ValidatorStore: keys + all signing duties with slashing protection
+(reference: packages/validator/src/services/validatorStore.ts).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_VOLUNTARY_EXIT,
+)
+from lodestar_tpu.state_transition.util.domain import (
+    compute_domain,
+    compute_signing_root,
+)
+from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+from lodestar_tpu.types import ssz
+from .slashing_protection import (
+    SignedAttestationRecord,
+    SignedBlockRecord,
+    SlashingProtection,
+)
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        secret_keys: List[bls.SecretKey],
+        fork_config,
+        genesis_validators_root: bytes,
+        slashing_protection: Optional[SlashingProtection] = None,
+    ):
+        self._by_pubkey: Dict[bytes, bls.SecretKey] = {
+            sk.to_public_key().to_bytes(): sk for sk in secret_keys
+        }
+        self.fork_config = fork_config
+        self.genesis_validators_root = genesis_validators_root
+        self.slashing_protection = slashing_protection or SlashingProtection()
+
+    @property
+    def pubkeys(self) -> List[bytes]:
+        return list(self._by_pubkey)
+
+    def has(self, pubkey: bytes) -> bool:
+        return pubkey in self._by_pubkey
+
+    def add(self, sk: bls.SecretKey) -> bytes:
+        pk = sk.to_public_key().to_bytes()
+        self._by_pubkey[pk] = sk
+        return pk
+
+    def remove(self, pubkey: bytes) -> bool:
+        return self._by_pubkey.pop(pubkey, None) is not None
+
+    def _sk(self, pubkey: bytes) -> bls.SecretKey:
+        if pubkey not in self._by_pubkey:
+            raise KeyError(f"unknown validator {pubkey.hex()[:16]}")
+        return self._by_pubkey[pubkey]
+
+    def _domain(self, domain_type: bytes, epoch: int) -> bytes:
+        version = self.fork_config.fork_version_at_epoch(epoch)
+        return compute_domain(domain_type, version, self.genesis_validators_root)
+
+    # signing duties ---------------------------------------------------
+
+    def sign_block(self, pubkey: bytes, block) -> "ssz.phase0.SignedBeaconBlock":
+        epoch = compute_epoch_at_slot(block.slot)
+        domain = self._domain(DOMAIN_BEACON_PROPOSER, epoch)
+        block_t = type(block)
+        root = compute_signing_root(block_t, block, domain)
+        self.slashing_protection.check_and_insert_block_proposal(
+            pubkey, SignedBlockRecord(slot=block.slot, signing_root=root)
+        )
+        sig = self._sk(pubkey).sign(root)
+        return ssz.phase0.SignedBeaconBlock(message=block, signature=sig.to_bytes())
+
+    def sign_attestation(
+        self, pubkey: bytes, data: "ssz.phase0.AttestationData", committee_size: int,
+        position: int,
+    ) -> "ssz.phase0.Attestation":
+        domain = self._domain(DOMAIN_BEACON_ATTESTER, data.target.epoch)
+        root = compute_signing_root(ssz.phase0.AttestationData, data, domain)
+        self.slashing_protection.check_and_insert_attestation(
+            pubkey,
+            SignedAttestationRecord(
+                source_epoch=data.source.epoch,
+                target_epoch=data.target.epoch,
+                signing_root=root,
+            ),
+        )
+        bits = [False] * committee_size
+        bits[position] = True
+        sig = self._sk(pubkey).sign(root)
+        return ssz.phase0.Attestation(
+            aggregation_bits=bits, data=data, signature=sig.to_bytes()
+        )
+
+    def sign_randao(self, pubkey: bytes, slot: int) -> bytes:
+        epoch = compute_epoch_at_slot(slot)
+        domain = self._domain(DOMAIN_RANDAO, epoch)
+        root = compute_signing_root(ssz.phase0.Epoch, epoch, domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_selection_proof(self, pubkey: bytes, slot: int) -> bytes:
+        epoch = compute_epoch_at_slot(slot)
+        domain = self._domain(DOMAIN_SELECTION_PROOF, epoch)
+        root = compute_signing_root(ssz.phase0.Slot, slot, domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_aggregate_and_proof(
+        self, pubkey: bytes, agg_and_proof: "ssz.phase0.AggregateAndProof"
+    ) -> "ssz.phase0.SignedAggregateAndProof":
+        epoch = compute_epoch_at_slot(agg_and_proof.aggregate.data.slot)
+        domain = self._domain(DOMAIN_AGGREGATE_AND_PROOF, epoch)
+        root = compute_signing_root(
+            ssz.phase0.AggregateAndProof, agg_and_proof, domain
+        )
+        sig = self._sk(pubkey).sign(root)
+        return ssz.phase0.SignedAggregateAndProof(
+            message=agg_and_proof, signature=sig.to_bytes()
+        )
+
+    def sign_voluntary_exit(
+        self, pubkey: bytes, validator_index: int, epoch: int
+    ) -> "ssz.phase0.SignedVoluntaryExit":
+        exit_ = ssz.phase0.VoluntaryExit(epoch=epoch, validator_index=validator_index)
+        domain = self._domain(DOMAIN_VOLUNTARY_EXIT, epoch)
+        root = compute_signing_root(ssz.phase0.VoluntaryExit, exit_, domain)
+        sig = self._sk(pubkey).sign(root)
+        return ssz.phase0.SignedVoluntaryExit(message=exit_, signature=sig.to_bytes())

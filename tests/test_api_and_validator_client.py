@@ -1,0 +1,122 @@
+"""End-to-end REST seam: BeaconRestApiServer over a live BeaconChain, a
+real HTTP round trip, and the Validator client performing proposal +
+attestation duties through the API — the reference's node<->VC process
+boundary (SURVEY §3.4).
+"""
+import asyncio
+
+import pytest
+
+from lodestar_tpu.api.client import ApiClient
+from lodestar_tpu.api.server import BeaconRestApiServer
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.config import ForkConfig, minimal_chain_config as cfg
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ACTIVE_PRESET_NAME
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.state_transition.util.interop import interop_secret_keys
+from lodestar_tpu.validator.validator import Validator
+from lodestar_tpu.validator.validator_store import ValidatorStore
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+E = _p.SLOTS_PER_EPOCH
+
+
+class FakeTime:
+    def __init__(self, t0=0.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+
+def test_vc_drives_bn_over_http():
+    async def go():
+        _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+        ft = FakeTime(0.0)
+        chain = BeaconChain(
+            cfg, BeaconDb(), anchor, clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=ft)
+        )
+        server = BeaconRestApiServer(chain, chain.db)
+        port = await server.listen()
+        api = ApiClient(f"http://127.0.0.1:{port}")
+
+        # node surface sanity over real HTTP
+        genesis = await api.get_genesis()
+        assert genesis["genesis_validators_root"] == (
+            "0x" + chain.genesis_validators_root.hex()
+        )
+        version = await api.get_version()
+        assert "lodestar-tpu" in version
+
+        store = ValidatorStore(
+            interop_secret_keys(8),
+            ForkConfig(cfg),
+            chain.genesis_validators_root,
+        )
+        vc = Validator(api, store)
+        await vc.initialize()
+        assert vc.indices == list(range(8))
+
+        # two epochs of full duties through the API
+        for slot in range(1, 2 * E + 1):
+            ft.t = slot * cfg.SECONDS_PER_SLOT
+            await vc.run_slot(slot)
+
+        head = chain.fork_choice.get_head()
+        assert head.slot == 2 * E, f"head at {head.slot}"
+        assert vc.produced_blocks == 2 * E
+        assert vc.produced_attestations >= 2 * E - 1
+        assert vc.produced_aggregates >= 1
+
+        syncing = await api.get_syncing()
+        assert syncing["is_syncing"] is False
+
+        await api.close()
+        await server.close()
+        await chain.close()
+
+    asyncio.run(go())
+
+
+def test_api_block_and_state_queries():
+    async def go():
+        _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+        ft = FakeTime(0.0)
+        chain = BeaconChain(
+            cfg, BeaconDb(), anchor, clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=ft)
+        )
+        server = BeaconRestApiServer(chain, chain.db)
+        port = await server.listen()
+        api = ApiClient(f"http://127.0.0.1:{port}")
+
+        # drive one block through the VC path
+        store = ValidatorStore(
+            interop_secret_keys(8), ForkConfig(cfg), chain.genesis_validators_root
+        )
+        vc = Validator(api, store)
+        await vc.initialize()
+        ft.t = cfg.SECONDS_PER_SLOT
+        root = await vc.propose_if_due(1)
+        assert root is not None
+
+        got = await api.get_block_root("head")
+        assert got == chain.head_root
+
+        validators = await api.get_validators()
+        assert len(validators) == 8
+        assert validators[0]["status"] == "active_ongoing"
+
+        # duties round trip
+        duties = await api.get_proposer_duties(0)
+        assert len(duties) == E
+
+        await api.close()
+        await server.close()
+        await chain.close()
+
+    asyncio.run(go())

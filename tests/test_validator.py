@@ -1,0 +1,168 @@
+"""Validator client components: slashing protection (EIP-3076 semantics +
+interchange), EIP-2335 keystores (spec scrypt vector), ValidatorStore
+signing duties.
+"""
+import pytest
+
+from lodestar_tpu.config import ForkConfig, minimal_chain_config as cfg
+from lodestar_tpu.crypto.bls.api import SecretKey
+from lodestar_tpu.types import ssz
+from lodestar_tpu.validator.keystore import (
+    KeystoreError,
+    create_keystore,
+    decrypt_keystore,
+)
+from lodestar_tpu.validator.slashing_protection import (
+    SignedAttestationRecord,
+    SignedBlockRecord,
+    SlashingProtection,
+    SlashingProtectionError,
+)
+from lodestar_tpu.validator.validator_store import ValidatorStore
+
+PK = b"\xaa" * 48
+GVR = b"\x11" * 32
+
+
+class TestSlashingProtection:
+    def test_block_double_proposal(self):
+        sp = SlashingProtection()
+        sp.check_and_insert_block_proposal(PK, SignedBlockRecord(10, b"\x01" * 32))
+        # same root: benign repeat
+        sp.check_and_insert_block_proposal(PK, SignedBlockRecord(10, b"\x01" * 32))
+        # different root, same slot: slashable
+        with pytest.raises(SlashingProtectionError, match="double"):
+            sp.check_and_insert_block_proposal(PK, SignedBlockRecord(10, b"\x02" * 32))
+        # lower slot than signed history: refused
+        with pytest.raises(SlashingProtectionError):
+            sp.check_and_insert_block_proposal(PK, SignedBlockRecord(9, b"\x03" * 32))
+        # higher slot fine
+        sp.check_and_insert_block_proposal(PK, SignedBlockRecord(11, b"\x04" * 32))
+
+    def test_attestation_double_vote(self):
+        sp = SlashingProtection()
+        sp.check_and_insert_attestation(PK, SignedAttestationRecord(0, 1, b"\x01" * 32))
+        sp.check_and_insert_attestation(PK, SignedAttestationRecord(0, 1, b"\x01" * 32))
+        with pytest.raises(SlashingProtectionError, match="double"):
+            sp.check_and_insert_attestation(
+                PK, SignedAttestationRecord(0, 1, b"\x02" * 32)
+            )
+
+    def test_attestation_surround(self):
+        sp = SlashingProtection()
+        sp.check_and_insert_attestation(PK, SignedAttestationRecord(2, 3, b"\x01" * 32))
+        # new surrounds old (1 < 2, 3 < 4)
+        with pytest.raises(SlashingProtectionError, match="surround"):
+            sp.check_and_insert_attestation(
+                PK, SignedAttestationRecord(1, 4, b"\x02" * 32)
+            )
+        sp.check_and_insert_attestation(PK, SignedAttestationRecord(3, 6, b"\x03" * 32))
+        # new surrounded by old (3<4, 5<6)
+        with pytest.raises(SlashingProtectionError, match="surrounded"):
+            sp.check_and_insert_attestation(
+                PK, SignedAttestationRecord(4, 5, b"\x04" * 32)
+            )
+
+    def test_interchange_round_trip_and_lower_bound(self):
+        sp = SlashingProtection()
+        sp.check_and_insert_block_proposal(PK, SignedBlockRecord(5, b"\x01" * 32))
+        sp.check_and_insert_attestation(PK, SignedAttestationRecord(1, 2, b"\x02" * 32))
+        obj = sp.export_interchange(GVR, [PK])
+        assert obj["metadata"]["interchange_format_version"] == "5"
+
+        sp2 = SlashingProtection()
+        sp2.import_interchange(obj, GVR)
+        # importing sets lower bounds: older attestations refused
+        with pytest.raises(SlashingProtectionError):
+            sp2.check_and_insert_attestation(
+                PK, SignedAttestationRecord(0, 2, b"\x03" * 32)
+            )
+        # newer ones allowed
+        sp2.check_and_insert_attestation(PK, SignedAttestationRecord(1, 3, b"\x04" * 32))
+        with pytest.raises(SlashingProtectionError, match="mismatch"):
+            sp2.import_interchange(obj, b"\x99" * 32)
+
+
+class TestKeystore:
+    SECRET = bytes.fromhex(
+        "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+    )
+
+    def test_eip2335_scrypt_vector(self):
+        """The spec's scrypt test vector (password 'testpassword🔑')."""
+        vec = {
+            "version": 4,
+            "uuid": "x",
+            "path": "m/12381/60/3141592653/589793238",
+            "pubkey": "",
+            "crypto": {
+                "kdf": {
+                    "function": "scrypt",
+                    "params": {
+                        "dklen": 32, "n": 262144, "r": 8, "p": 1,
+                        "salt": "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3",
+                    },
+                    "message": "",
+                },
+                "checksum": {
+                    "function": "sha256", "params": {},
+                    "message": "d2217fe5f3e9a1e34581ef8a78f7c9928e436d36dacc5e846690a5581e8ea484",
+                },
+                "cipher": {
+                    "function": "aes-128-ctr",
+                    "params": {"iv": "264daa3f303d7259501c93d997d84fe6"},
+                    "message": "06ae90d55fe0a6e9c5c3bc5b170827b2e5cce3929ed3f116c2811e6366dfe20f",
+                },
+            },
+        }
+        assert decrypt_keystore(vec, "testpassword\U0001F511") == self.SECRET
+
+    def test_round_trip_both_kdfs(self):
+        for kdf in ("scrypt", "pbkdf2"):
+            ks = create_keystore(self.SECRET, "hunter2", kdf=kdf)
+            assert decrypt_keystore(ks, "hunter2") == self.SECRET
+            with pytest.raises(KeystoreError):
+                decrypt_keystore(ks, "wrong-password")
+
+
+class TestValidatorStore:
+    def make_store(self):
+        sks = [SecretKey.from_bytes(bytes(31) + bytes([i + 1])) for i in range(2)]
+        return ValidatorStore(sks, ForkConfig(cfg), GVR), sks
+
+    def test_sign_block_with_protection(self):
+        store, sks = self.make_store()
+        pk = store.pubkeys[0]
+        block = ssz.phase0.BeaconBlock.default()
+        block.slot = 5
+        signed = store.sign_block(pk, block)
+        assert len(bytes(signed.signature)) == 96
+        # re-signing a DIFFERENT block at the same slot is refused
+        block2 = ssz.phase0.BeaconBlock.default()
+        block2.slot = 5
+        block2.proposer_index = 1
+        with pytest.raises(SlashingProtectionError):
+            store.sign_block(pk, block2)
+
+    def test_sign_attestation_with_protection(self):
+        store, _ = self.make_store()
+        pk = store.pubkeys[0]
+        data = ssz.phase0.AttestationData.default()
+        data.slot = 8
+        data.target.epoch = 1
+        att = store.sign_attestation(pk, data, committee_size=4, position=2)
+        assert att.aggregation_bits == [False, False, True, False]
+        data2 = ssz.phase0.AttestationData.default()
+        data2.slot = 9
+        data2.target.epoch = 1
+        data2.index = 1  # different data, same target
+        with pytest.raises(SlashingProtectionError):
+            store.sign_attestation(pk, data2, committee_size=4, position=1)
+
+    def test_selection_proof_and_randao(self):
+        store, _ = self.make_store()
+        pk = store.pubkeys[0]
+        assert len(store.sign_selection_proof(pk, 3)) == 96
+        assert len(store.sign_randao(pk, 3)) == 96
+        with pytest.raises(KeyError):
+            store.sign_randao(b"\x00" * 48, 3)
