@@ -1,0 +1,53 @@
+"""Sync committee computation (reference:
+packages/state-transition/src/util/syncCommittee.ts getNextSyncCommittee;
+consensus-specs altair).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import ACTIVE_PRESET as _p, DOMAIN_SYNC_COMMITTEE
+from lodestar_tpu.types import ssz
+from .misc import (
+    compute_epoch_at_slot,
+    compute_shuffled_index,
+    get_seed,
+    int_to_bytes,
+    sha256,
+)
+
+MAX_RANDOM_BYTE = 255
+
+
+def get_next_sync_committee_indices(state, active_indices: Sequence[int],
+                                    effective_balances: Sequence[int]) -> List[int]:
+    """Spec get_next_sync_committee_indices: balance-weighted sampling over
+    the shuffled active set at epoch+1."""
+    epoch = compute_epoch_at_slot(state.slot) + 1
+    seed = get_seed(state, epoch, DOMAIN_SYNC_COMMITTEE)
+    n = len(active_indices)
+    out: List[int] = []
+    i = 0
+    while len(out) < _p.SYNC_COMMITTEE_SIZE:
+        shuffled = compute_shuffled_index(i % n, n, seed)
+        candidate = int(active_indices[shuffled])
+        random_byte = sha256(seed + int_to_bytes(i // 32, 8))[i % 32]
+        if effective_balances[candidate] * MAX_RANDOM_BYTE >= (
+            _p.MAX_EFFECTIVE_BALANCE * random_byte
+        ):
+            out.append(candidate)
+        i += 1
+    return out
+
+
+def get_next_sync_committee(state, active_indices, effective_balances):
+    indices = get_next_sync_committee_indices(state, active_indices, effective_balances)
+    pubkeys = [bytes(state.validators[i].pubkey) for i in indices]
+    agg = bls.aggregate_public_keys(
+        [bls.PublicKey.from_bytes(pk) for pk in pubkeys]
+    )
+    committee = ssz.altair.SyncCommittee(
+        pubkeys=pubkeys, aggregate_pubkey=agg.to_bytes()
+    )
+    return committee, indices
