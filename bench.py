@@ -2,9 +2,7 @@
 
 Measures the batched signature-set verification kernel (BASELINE.md target
 config 1: 128 single-pubkey sets, the shape of the reference's max worker
-job, packages/beacon-node/src/chain/bls/multithread/index.ts:39) and
-fastAggregateVerify (config 2: 1 msg x 2048 aggregated pubkeys,
-sync-committee shape).
+job, packages/beacon-node/src/chain/bls/multithread/index.ts:39).
 
 Headline metric: BLS sigs verified per second per chip on the device
 verification path (scalar muls + Miller loops + shared final exp), with
@@ -14,6 +12,13 @@ batch-verify throughput derived from its recorded engineering constant:
 (packages/beacon-node/src/chain/blocks/verifyBlocksSignatures.ts:41-43)
 => ~2,200 sigs/s single-threaded.
 
+Robustness: XLA compile time for the pairing program is unbounded on a
+cold cache, and the driver runs this under an external timeout.  The
+parent process therefore stages child runs (large batch first, smaller
+fallbacks) each under its own wall-clock cap, and ALWAYS prints exactly
+one JSON line from the best stage that finished.  A warm persistent
+compilation cache (.jax_cache) makes the flagship stage take seconds.
+
 Correctness is asserted in-run (valid batch accepts, corrupted rejects)
 before any timing is recorded.
 """
@@ -21,27 +26,29 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 os.environ.setdefault("LODESTAR_TPU_PRESET", "mainnet")
 
+BASELINE_SIGS_PER_SEC = 2200.0  # reference CPU batched blst (see docstring)
 
-def main() -> None:
+
+def run_config(batch: int, iters: int) -> dict:
+    """Measure one batch size; returns the result dict (child mode)."""
     import jax
     import jax.numpy as jnp
 
-    cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from lodestar_tpu.crypto.bls import api
     from lodestar_tpu.ops.bls12_381 import curve as cv, verify as dv
 
-    B = int(os.environ.get("BENCH_BATCH", "128"))
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
-
     # --- build a valid batch of B signature sets (host oracle signs) ----
+    B = batch
     sets = []
     for i in range(B):
         sk = api.SecretKey.from_bytes((i + 1).to_bytes(32, "big"))
@@ -77,12 +84,11 @@ def main() -> None:
     p99_s = times[min(len(times) - 1, int(0.99 * len(times)))]
     sigs_per_sec = B / mean_s
 
-    baseline_sigs_per_sec = 2200.0  # reference CPU batched blst (see docstring)
-    result = {
+    return {
         "metric": "bls_batch_verify_sigs_per_sec_per_chip",
         "value": round(sigs_per_sec, 1),
         "unit": "sigs/s",
-        "vs_baseline": round(sigs_per_sec / baseline_sigs_per_sec, 3),
+        "vs_baseline": round(sigs_per_sec / BASELINE_SIGS_PER_SEC, 3),
         "batch_size": B,
         "mean_batch_latency_ms": round(mean_s * 1e3, 2),
         "p99_batch_latency_ms": round(p99_s * 1e3, 2),
@@ -90,6 +96,71 @@ def main() -> None:
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
     }
+
+
+def _child_main(batch: int, iters: int) -> None:
+    print(json.dumps(run_config(batch, iters)), flush=True)
+
+
+def _run_stage(batch: int, iters: int, timeout_s: float) -> dict | None:
+    """Run one config in a subprocess under its own wall-clock cap."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", str(batch), str(iters)]
+    try:
+        proc = subprocess.run(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=sys.stderr,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench: stage B={batch} exceeded {timeout_s:.0f}s, trying smaller",
+              file=sys.stderr, flush=True)
+        return None
+    if proc.returncode != 0:
+        print(f"bench: stage B={batch} failed rc={proc.returncode}",
+              file=sys.stderr, flush=True)
+        return None
+    for line in proc.stdout.decode().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        _child_main(int(sys.argv[2]), int(sys.argv[3]))
+        return
+
+    budget = float(os.environ.get("BENCH_BUDGET_S", "2100"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    deadline = time.time() + budget
+    result = None
+    # flagship first; fall back to smaller (cheaper-to-compile) batches.
+    # Each stage is capped below the remaining budget so a timed-out
+    # flagship still leaves room for the fallbacks to finish.
+    stages = (int(os.environ.get("BENCH_BATCH", "128")), 32, 8)
+    for i, batch in enumerate(stages):
+        remaining = deadline - time.time()
+        if remaining < 60:
+            break
+        is_last = i == len(stages) - 1
+        cap = remaining if is_last else remaining * 0.6
+        result = _run_stage(batch, iters, cap)
+        if result is not None:
+            break
+    if result is None:
+        result = {
+            "metric": "bls_batch_verify_sigs_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "sigs/s",
+            "vs_baseline": 0.0,
+            "error": "no stage finished within budget (cold XLA compile)",
+        }
     print(json.dumps(result))
 
 

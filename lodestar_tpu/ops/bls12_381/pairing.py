@@ -146,16 +146,46 @@ def _pow_neg_x(a):
 
 
 def final_exponentiation(f):
-    """f^((p^6-1)(p^2+1) * 3(p^4-p^2+1)/r) — same chain as the oracle."""
+    """f^((p^6-1)(p^2+1) * 3(p^4-p^2+1)/r) — same chain as the oracle.
+
+    The hard part needs FIVE x-adic exponentiations.  Naively each becomes
+    its own 64-iteration scan and XLA compiles five copies of the (large)
+    square-and-multiply body — measured ~418 s of the TPU compile budget.
+    Instead ONE outer scan runs the pow with a per-step epilogue selected
+    by ``lax.switch``: the pow body compiles once (~5x compile saving),
+    the tiny epilogues are the only duplicated code.
+
+      step0: t0 = conj(x^|x| * x)         x = m
+      step1: t1 = conj(x^|x| * x)         x = t0
+      step2: a  = conj(x^|x|) * frob(x,1) x = t1
+      step3: b  = conj(x^|x|)             x = a
+      step4: t4 = conj(x^|x|) * frob(prev,2) * conj(prev)   prev = a
+    """
     # easy part
     f1 = tw.f12_mul(tw.f12_conj(f), tw.f12_inv(f))
     m = tw.f12_mul(tw.f12_frobenius(f1, 2), f1)
-    # hard part (times 3): (x-1)^2 (x+p) (x^2+p^2-1) + 3
-    t0 = tw.f12_conj(tw.f12_mul(_cyclotomic_pow_abs_x(m), m))
-    t1 = tw.f12_conj(tw.f12_mul(_cyclotomic_pow_abs_x(t0), t0))
-    a = tw.f12_mul(_pow_neg_x(t1), tw.f12_frobenius(t1, 1))
-    b = _pow_neg_x(a)
-    t4 = tw.f12_mul(tw.f12_mul(_pow_neg_x(b), tw.f12_frobenius(a, 2)), tw.f12_conj(a))
+
+    def epi01(p, x, prev):
+        return tw.f12_conj(tw.f12_mul(p, x))
+
+    def epi2(p, x, prev):
+        return tw.f12_mul(tw.f12_conj(p), tw.f12_frobenius(x, 1))
+
+    def epi3(p, x, prev):
+        return tw.f12_conj(p)
+
+    def epi4(p, x, prev):
+        return tw.f12_mul(
+            tw.f12_mul(tw.f12_conj(p), tw.f12_frobenius(prev, 2)), tw.f12_conj(prev)
+        )
+
+    def body(carry, k):
+        x, prev = carry
+        p = _cyclotomic_pow_abs_x(x)
+        out = jax.lax.switch(k, (epi01, epi01, epi2, epi3, epi4), p, x, prev)
+        return (out, x), None
+
+    (t4, _), _ = jax.lax.scan(body, (m, m), jnp.arange(5))
     return tw.f12_mul(t4, tw.f12_mul(tw.f12_sqr(m), m))
 
 

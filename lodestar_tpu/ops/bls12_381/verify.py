@@ -201,15 +201,21 @@ def verify_signature_sets(
     rpk_aff, rpk_inf = batch_to_affine(cv.F1, rpk)
     (ss_aff, ss_inf) = _single_to_affine_g2(sig_sum)
 
-    # Miller product over the B message pairs ...
-    mask = active & ~rpk_inf & ~msg_inf
-    f_msgs = multi_miller_product(msg_aff, rpk_aff, mask)
-    # ... times the signature leg e(-G1, sum r_i sig_i)
-    f_sig = pr.miller_loop(ss_aff, (_NEG_G1_X, _NEG_G1_Y))
-    ones = tw.f12_one(shape=())
-    f_sig = tw.f12_select(ss_inf, ones, f_sig)
+    # ONE (B+1)-batch Miller product: the B message pairs plus the
+    # signature leg e(-G1, sum r_i sig_i) appended as entry B — a single
+    # scan instance instead of two separately-compiled loops.
+    def _append(batch, single):
+        return jax.tree.map(
+            lambda b, s: jnp.concatenate([b, s[None]]), batch, single
+        )
 
-    f = tw.f12_mul(f_msgs, f_sig)
+    q_all = _append(msg_aff, ss_aff)
+    neg_g1 = (_NEG_G1_X, _NEG_G1_Y)
+    p_all = _append(rpk_aff, neg_g1)
+    mask = jnp.concatenate(
+        [active & ~rpk_inf & ~msg_inf, (~ss_inf)[None]]
+    )
+    f = multi_miller_product(q_all, p_all, mask)
     return tw.f12_is_one(pr.final_exponentiation(f))
 
 
@@ -229,8 +235,13 @@ def verify_each(pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active):
     negx = jnp.broadcast_to(_NEG_G1_X, pk_aff[0].shape)
     negy = jnp.broadcast_to(_NEG_G1_Y, pk_aff[1].shape)
 
-    f_msg = pr.miller_loop(msg_aff, pk_aff)  # (B,) Fp12
-    f_sig = pr.miller_loop(sig_aff, (negx, negy))  # (B,) Fp12
+    # one 2B-batch Miller instance: [e(pk_i, H_i) legs; e(-G1, sig_i) legs]
+    cat = lambda a, b: jax.tree.map(
+        lambda x, y: jnp.concatenate([x, y]), a, b
+    )
+    f_all = pr.miller_loop(cat(msg_aff, sig_aff), cat(pk_aff, (negx, negy)))
+    f_msg = jax.tree.map(lambda t: t[: t.shape[0] // 2], f_all)  # (B,) Fp12
+    f_sig = jax.tree.map(lambda t: t[t.shape[0] // 2 :], f_all)  # (B,) Fp12
 
     B = pk_aff[0].shape[0]
     ones = tw.f12_one(shape=(B,))
@@ -301,12 +312,16 @@ def verify_signature_sets_device(sets, rand=None) -> bool:
         if s.public_key.point is None or s.signature.point is None:
             return False
     size = bucket_size(len(sets))
-    enc = _encode_sets(sets, size)
+    pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active = _encode_sets(
+        sets, size
+    )
     if rand is None:
         rand = [int.from_bytes(_os.urandom(8), "big") | 1 for _ in sets]
     rand = list(rand) + [1] * (size - len(rand))
     bits = cv.scalars_to_bits(rand, 64)
-    return bool(_jit_batch(*enc, bits))
+    return bool(
+        _jit_batch(pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, bits, active)
+    )
 
 
 def verify_each_device(sets):
