@@ -1,0 +1,139 @@
+"""Metrics subsystem (reference: packages/beacon-node/src/metrics/).
+
+Three metric groups like the reference's createMetrics (metrics.ts:14):
+- beacon: spec-standard names (metrics/metrics/beacon.ts)
+- lodestar: internal instrumentation (metrics/metrics/lodestar.ts) —
+  block pipeline timings, gossip queues, regen, op pools; the BLS pool
+  family lives in chain/bls/metrics.py and shares the same registry
+- process: Python runtime stats (prom-client collectDefaultMetrics role)
+
+plus the per-validator duty tracker (validator_monitor.py mirroring
+createValidatorMonitor, metrics/validatorMonitor.ts:165) and the HTTP
+exposition server (server.py, metrics/server/).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    REGISTRY,
+    generate_latest,
+)
+
+from .validator_monitor import ValidatorMonitor  # noqa: F401
+
+
+class BeaconMetrics:
+    """Spec-standard beacon metrics (metrics/metrics/beacon.ts)."""
+
+    def __init__(self, registry: CollectorRegistry):
+        self.head_slot = Gauge(
+            "beacon_head_slot", "Slot of the head block", registry=registry
+        )
+        self.finalized_epoch = Gauge(
+            "beacon_finalized_epoch", "Latest finalized epoch", registry=registry
+        )
+        self.current_justified_epoch = Gauge(
+            "beacon_current_justified_epoch",
+            "Latest justified epoch",
+            registry=registry,
+        )
+        self.proposed_blocks_total = Counter(
+            "beacon_proposed_blocks_total",
+            "Blocks imported as head proposals",
+            registry=registry,
+        )
+        self.reorgs_total = Counter(
+            "beacon_reorgs_total", "Detected chain reorganizations", registry=registry
+        )
+        self.peers = Gauge(
+            "beacon_peers", "Connected libp2p peers", registry=registry
+        )
+        self.clock_slot = Gauge(
+            "beacon_clock_slot", "Current wall-clock slot", registry=registry
+        )
+
+
+class LodestarMetrics:
+    """Internal instrumentation (metrics/metrics/lodestar.ts)."""
+
+    def __init__(self, registry: CollectorRegistry):
+        ns = "lodestar_tpu"
+        self.block_import_seconds = Histogram(
+            f"{ns}_block_import_seconds",
+            "Wall time of the full verify+import pipeline per block",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10),
+            registry=registry,
+        )
+        self.stfn_seconds = Histogram(
+            f"{ns}_stfn_seconds",
+            "State transition wall time per block",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5),
+            registry=registry,
+        )
+        self.block_sig_verify_seconds = Histogram(
+            f"{ns}_block_sig_verify_seconds",
+            "Signature-set verification wall time per block "
+            "(verifyBlocksSignatures.ts:49 latency)",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5),
+            registry=registry,
+        )
+        self.block_queue_length = Gauge(
+            f"{ns}_block_processor_queue_length",
+            "Blocks waiting in the BlockProcessor job queue",
+            registry=registry,
+        )
+        self.gossip_queue_length = Gauge(
+            f"{ns}_gossip_validation_queue_length",
+            "Per-topic gossip validation queue length",
+            ["topic"],
+            registry=registry,
+        )
+        self.gossip_queue_dropped = Counter(
+            f"{ns}_gossip_validation_queue_dropped_total",
+            "Gossip jobs dropped by full queues",
+            ["topic"],
+            registry=registry,
+        )
+        self.regen_requests = Counter(
+            f"{ns}_regen_requests_total",
+            "State regeneration cache misses (replay path)",
+            registry=registry,
+        )
+        self.state_cache_size = Gauge(
+            f"{ns}_state_cache_size", "States held by the LRU", registry=registry
+        )
+        self.op_pool_attestations = Gauge(
+            f"{ns}_op_pool_attestation_count",
+            "Attestations buffered for aggregation/packing",
+            registry=registry,
+        )
+
+
+class Metrics:
+    """Composition root: one registry, all groups (createMetrics)."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        # a private registry by default so tests can create many instances
+        self.registry = registry or CollectorRegistry()
+        self.beacon = BeaconMetrics(self.registry)
+        self.lodestar = LodestarMetrics(self.registry)
+        self.validator_monitor = ValidatorMonitor(self.registry)
+
+    def expose(self) -> bytes:
+        """Prometheus text exposition of the whole registry."""
+        return generate_latest(self.registry)
+
+
+_default: Optional[Metrics] = None
+
+
+def get_metrics() -> Metrics:
+    global _default
+    if _default is None:
+        _default = Metrics()
+    return _default

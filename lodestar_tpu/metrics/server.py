@@ -1,0 +1,39 @@
+"""Prometheus exposition endpoint (reference:
+packages/beacon-node/src/metrics/server/ HttpMetricsServer).
+
+Serves GET /metrics in text format from a Metrics registry over aiohttp,
+like the node's scrape target in prometheus.yml.
+"""
+from __future__ import annotations
+
+from aiohttp import web
+
+from . import Metrics
+
+
+class HttpMetricsServer:
+    def __init__(self, metrics: Metrics, host: str = "127.0.0.1", port: int = 8008):
+        self.metrics = metrics
+        self.host = host
+        self.port = port
+        self._runner = None
+        self.app = web.Application()
+        self.app.router.add_get("/metrics", self._handle)
+
+    async def _handle(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.expose(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,127 @@
+"""Per-validator duty tracking (reference:
+packages/beacon-node/src/metrics/validatorMonitor.ts:165
+createValidatorMonitor).
+
+Registered (tracked) validators get per-epoch summaries of attestation
+performance — seen on gossip, included in blocks, inclusion distance —
+and block proposals, surfaced both as Prometheus metrics and as queryable
+epoch summaries (the reference logs these per epoch).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
+
+
+@dataclass
+class EpochSummary:
+    """One tracked validator's performance within one epoch
+    (validatorMonitor.ts EpochSummary)."""
+
+    attestations_seen: int = 0
+    attestation_min_delay_sec: Optional[float] = None
+    attestation_included: bool = False
+    attestation_inclusion_distance: Optional[int] = None
+    blocks_proposed: int = 0
+    aggregates_seen: int = 0
+
+
+class ValidatorMonitor:
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self._tracked: Dict[int, Dict[int, EpochSummary]] = {}
+        reg = registry
+        self.m_attestation_seen = Counter(
+            "validator_monitor_attestation_total",
+            "Tracked validators' attestations seen on gossip or in blocks",
+            registry=reg,
+        )
+        self.m_attestation_included = Counter(
+            "validator_monitor_attestation_in_block_total",
+            "Tracked validators' attestations included on chain",
+            registry=reg,
+        )
+        self.m_inclusion_distance = Histogram(
+            "validator_monitor_attestation_inclusion_distance",
+            "Slots between attestation and inclusion",
+            buckets=(1, 2, 3, 4, 8, 16, 32),
+            registry=reg,
+        )
+        self.m_blocks_proposed = Counter(
+            "validator_monitor_beacon_block_total",
+            "Tracked validators' proposed blocks imported",
+            registry=reg,
+        )
+        self.m_tracked = Gauge(
+            "validator_monitor_validators",
+            "Number of tracked validator indices",
+            registry=reg,
+        )
+
+    # -- registration ---------------------------------------------------
+
+    def register_validator(self, index: int) -> None:
+        if index not in self._tracked:
+            self._tracked[index] = {}
+            self.m_tracked.set(len(self._tracked))
+
+    def tracked(self) -> List[int]:
+        return sorted(self._tracked)
+
+    def _summary(self, index: int, epoch: int) -> Optional[EpochSummary]:
+        epochs = self._tracked.get(index)
+        if epochs is None:
+            return None
+        if epoch not in epochs:
+            epochs[epoch] = EpochSummary()
+        return epochs[epoch]
+
+    # -- event hooks (mirroring registerGossipAttestation etc.) ---------
+
+    def on_gossip_attestation(
+        self, index: int, target_epoch: int, delay_sec: float
+    ) -> None:
+        s = self._summary(index, target_epoch)
+        if s is None:
+            return
+        s.attestations_seen += 1
+        if s.attestation_min_delay_sec is None or delay_sec < s.attestation_min_delay_sec:
+            s.attestation_min_delay_sec = delay_sec
+        self.m_attestation_seen.inc()
+
+    def on_attestation_in_block(
+        self, index: int, target_epoch: int, inclusion_distance: int
+    ) -> None:
+        s = self._summary(index, target_epoch)
+        if s is None:
+            return
+        s.attestations_seen += 1
+        if not s.attestation_included or (
+            s.attestation_inclusion_distance is not None
+            and inclusion_distance < s.attestation_inclusion_distance
+        ):
+            s.attestation_inclusion_distance = inclusion_distance
+        s.attestation_included = True
+        self.m_attestation_included.inc()
+        self.m_inclusion_distance.observe(inclusion_distance)
+
+    def on_block_imported(self, proposer_index: int, epoch: int) -> None:
+        s = self._summary(proposer_index, epoch)
+        if s is None:
+            return
+        s.blocks_proposed += 1
+        self.m_blocks_proposed.inc()
+
+    # -- queries --------------------------------------------------------
+
+    def epoch_summary(self, index: int, epoch: int) -> Optional[EpochSummary]:
+        epochs = self._tracked.get(index)
+        if epochs is None:
+            return None
+        return epochs.get(epoch)
+
+    def prune(self, before_epoch: int) -> None:
+        for epochs in self._tracked.values():
+            for e in [e for e in epochs if e < before_epoch]:
+                del epochs[e]

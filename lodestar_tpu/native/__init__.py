@@ -1,0 +1,155 @@
+"""Native runtime kernels: build-on-first-import C library + ctypes bindings.
+
+The reference ships its host hot loops as native/WASM deps (SURVEY §2.3:
+@chainsafe/as-sha256 for merkleization, xxhash-wasm for gossip message
+ids, snappy for wire compression).  Here they are one dependency-free C
+translation unit (csrc/lodestar_native.c) compiled to a shared library
+with the system compiler the first time it's needed and bound via ctypes
+(the environment has no pybind11; ctypes keeps the binding zero-build).
+
+Every consumer keeps a pure-Python fallback: `available()` gates use, and
+LODESTAR_TPU_NO_NATIVE=1 disables the native path entirely (useful for
+differential tests of the fallbacks).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csrc", "lodestar_native.c")
+_LIB_PATH = os.path.join(_HERE, f"_lodestar_native_{sys.platform}.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cc = os.environ.get("CC", "cc")
+    cmd = [cc, "-O3", "-shared", "-fPIC", "-fvisibility=hidden",
+           "-o", _LIB_PATH, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return proc.returncode == 0 and os.path.exists(_LIB_PATH)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ls_sha256.argtypes = [ctypes.c_char_p, ctypes.c_size_t, u8p]
+    lib.ls_sha256.restype = None
+    lib.ls_hash_pairs.argtypes = [ctypes.c_char_p, u8p, ctypes.c_size_t]
+    lib.ls_hash_pairs.restype = None
+    lib.ls_hash_layer.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                  ctypes.c_char_p, u8p]
+    lib.ls_hash_layer.restype = None
+    lib.ls_xxh64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
+    lib.ls_xxh64.restype = ctypes.c_uint64
+    lib.ls_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.ls_crc32c.restype = ctypes.c_uint32
+    lib.ls_snappy_max_compressed.argtypes = [ctypes.c_size_t]
+    lib.ls_snappy_max_compressed.restype = ctypes.c_size_t
+    lib.ls_snappy_compress.argtypes = [ctypes.c_char_p, ctypes.c_size_t, u8p]
+    lib.ls_snappy_compress.restype = ctypes.c_long
+    lib.ls_snappy_uncompressed_length.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.ls_snappy_uncompressed_length.restype = ctypes.c_long
+    lib.ls_snappy_uncompress.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                         u8p, ctypes.c_size_t]
+    lib.ls_snappy_uncompress.restype = ctypes.c_long
+    return lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried:
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("LODESTAR_TPU_NO_NATIVE") == "1":
+            return None
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+            ):
+                if not _build():
+                    return None
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# typed wrappers
+# ---------------------------------------------------------------------------
+
+
+def sha256(data: bytes) -> bytes:
+    lib = _load()
+    out = (ctypes.c_uint8 * 32)()
+    lib.ls_sha256(data, len(data), out)
+    return bytes(out)
+
+
+def hash_pairs(data: bytes) -> bytes:
+    """n*64 bytes of concatenated node pairs -> n*32 bytes of parents."""
+    n = len(data) // 64
+    out = (ctypes.c_uint8 * (32 * n))()
+    lib = _load()
+    lib.ls_hash_pairs(data, out, n)
+    return bytes(out)
+
+
+def hash_layer(nodes: bytes, zero: bytes) -> bytes:
+    """A merkle layer of len(nodes)/32 nodes -> ceil(n/2) parent nodes;
+    an odd tail is paired with `zero`."""
+    n = len(nodes) // 32
+    out_n = (n + 1) // 2
+    out = (ctypes.c_uint8 * (32 * out_n))()
+    lib = _load()
+    lib.ls_hash_layer(nodes, n, zero, out)
+    return bytes(out)
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    return int(_load().ls_xxh64(data, len(data), seed))
+
+
+def crc32c(data: bytes) -> int:
+    return int(_load().ls_crc32c(data, len(data)))
+
+
+def snappy_compress(data: bytes) -> bytes:
+    lib = _load()
+    cap = lib.ls_snappy_max_compressed(len(data))
+    out = (ctypes.c_uint8 * cap)()
+    n = lib.ls_snappy_compress(data, len(data), out)
+    if n < 0:
+        raise ValueError("snappy compression failed")
+    return bytes(out[:n])
+
+
+def snappy_uncompress(data: bytes, max_len: int = 1 << 27) -> bytes:
+    lib = _load()
+    n = lib.ls_snappy_uncompressed_length(data, len(data))
+    if n < 0 or n > max_len:
+        raise ValueError("invalid snappy length")
+    out = (ctypes.c_uint8 * n)() if n else (ctypes.c_uint8 * 1)()
+    got = lib.ls_snappy_uncompress(data, len(data), out, n)
+    if got != n:
+        raise ValueError("corrupt snappy data")
+    return bytes(out[:n])

@@ -1,0 +1,434 @@
+/* lodestar_tpu native runtime kernels.
+ *
+ * TPU-native rebuild of the reference's native/WASM host dependencies
+ * (SURVEY §2.3): @chainsafe/as-sha256 (SSZ merkleization hashing),
+ * xxhash-wasm (gossip fast message ids), @chainsafe/snappy-stream /
+ * snappyjs (gossip + reqresp compression, CRC-32C framing checksums).
+ *
+ * Single translation unit, no external dependencies; built as a shared
+ * library at first import (lodestar_tpu/native/__init__.py) and bound
+ * with ctypes.  All entry points are plain C ABI.
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+#if defined(_MSC_VER)
+#define LS_EXPORT __declspec(dllexport)
+#else
+#define LS_EXPORT __attribute__((visibility("default")))
+#endif
+
+/* ================================================================== */
+/* SHA-256 (FIPS 180-4)                                               */
+/* ================================================================== */
+
+static const uint32_t K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+#define ROTR(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
+#define SHR(x, n) ((x) >> (n))
+#define CH(x, y, z) (((x) & (y)) ^ (~(x) & (z)))
+#define MAJ(x, y, z) (((x) & (y)) ^ ((x) & (z)) ^ ((y) & (z)))
+#define BSIG0(x) (ROTR(x, 2) ^ ROTR(x, 13) ^ ROTR(x, 22))
+#define BSIG1(x) (ROTR(x, 6) ^ ROTR(x, 11) ^ ROTR(x, 25))
+#define SSIG0(x) (ROTR(x, 7) ^ ROTR(x, 18) ^ SHR(x, 3))
+#define SSIG1(x) (ROTR(x, 17) ^ ROTR(x, 19) ^ SHR(x, 10))
+
+static const uint32_t H256_INIT[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                      0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                      0x1f83d9ab, 0x5be0cd19};
+
+static inline uint32_t load_be32(const uint8_t *p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+static inline void store_be32(uint8_t *p, uint32_t v) {
+  p[0] = (uint8_t)(v >> 24);
+  p[1] = (uint8_t)(v >> 16);
+  p[2] = (uint8_t)(v >> 8);
+  p[3] = (uint8_t)v;
+}
+
+static void sha256_compress(uint32_t st[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  uint32_t a, b, c, d, e, f, g, h, t1, t2;
+  int i;
+  for (i = 0; i < 16; i++) w[i] = load_be32(block + 4 * i);
+  for (i = 16; i < 64; i++)
+    w[i] = SSIG1(w[i - 2]) + w[i - 7] + SSIG0(w[i - 15]) + w[i - 16];
+  a = st[0]; b = st[1]; c = st[2]; d = st[3];
+  e = st[4]; f = st[5]; g = st[6]; h = st[7];
+  for (i = 0; i < 64; i++) {
+    t1 = h + BSIG1(e) + CH(e, f, g) + K256[i] + w[i];
+    t2 = BSIG0(a) + MAJ(a, b, c);
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+  st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+}
+
+LS_EXPORT void ls_sha256(const uint8_t *data, size_t len, uint8_t out[32]) {
+  uint32_t st[8];
+  uint8_t block[64];
+  size_t i, rem;
+  uint64_t bitlen = (uint64_t)len * 8;
+  memcpy(st, H256_INIT, sizeof(st));
+  for (i = 0; i + 64 <= len; i += 64) sha256_compress(st, data + i);
+  rem = len - i;
+  memset(block, 0, 64);
+  memcpy(block, data + i, rem);
+  block[rem] = 0x80;
+  if (rem >= 56) {
+    sha256_compress(st, block);
+    memset(block, 0, 64);
+  }
+  for (i = 0; i < 8; i++) block[56 + i] = (uint8_t)(bitlen >> (56 - 8 * i));
+  sha256_compress(st, block);
+  for (i = 0; i < 8; i++) store_be32(out + 4 * i, st[i]);
+}
+
+/* The merkleization workhorse: hash n pairs of 32-byte nodes (64-byte
+ * messages).  The second (padding) block is constant for 64-byte input:
+ * 0x80, zeros, bitlen 512. */
+LS_EXPORT void ls_hash_pairs(const uint8_t *in, uint8_t *out, size_t n) {
+  static uint8_t pad[64];
+  uint32_t st[8];
+  size_t k;
+  int i;
+  pad[0] = 0x80;
+  pad[62] = 0x02; /* 512 bits big-endian -> bytes 62,63 = 0x02,0x00 */
+  for (k = 0; k < n; k++) {
+    memcpy(st, H256_INIT, sizeof(st));
+    sha256_compress(st, in + 64 * k);
+    sha256_compress(st, pad);
+    for (i = 0; i < 8; i++) store_be32(out + 32 * k + 4 * i, st[i]);
+  }
+}
+
+/* Hash a merkle layer of n nodes into ceil(n/2) nodes; odd tail is paired
+ * with `zero` (the zero-subtree hash of this level). */
+LS_EXPORT void ls_hash_layer(const uint8_t *in, size_t n, const uint8_t zero[32],
+                             uint8_t *out) {
+  size_t pairs = n / 2;
+  ls_hash_pairs(in, out, pairs);
+  if (n % 2) {
+    uint8_t buf[64];
+    memcpy(buf, in + 64 * pairs, 32);
+    memcpy(buf + 32, zero, 32);
+    ls_hash_pairs(buf, out + 32 * pairs, 1);
+  }
+}
+
+/* ================================================================== */
+/* xxHash64 (xxhash.com reference algorithm)                          */
+/* ================================================================== */
+
+#define P1 0x9E3779B185EBCA87ULL
+#define P2 0xC2B2AE3D27D4EB4FULL
+#define P3 0x165667B19E3779F9ULL
+#define P4 0x85EBCA77C2B2AE63ULL
+#define P5 0x27D4EB2F165667C5ULL
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t load_le64(const uint8_t *p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v; /* little-endian hosts only (x86/arm) */
+}
+
+static inline uint32_t load_le32(const uint8_t *p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint64_t xxh_round(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl64(acc, 31);
+  acc *= P1;
+  return acc;
+}
+
+static inline uint64_t xxh_merge(uint64_t acc, uint64_t val) {
+  val = xxh_round(0, val);
+  acc ^= val;
+  acc = acc * P1 + P4;
+  return acc;
+}
+
+LS_EXPORT uint64_t ls_xxh64(const uint8_t *p, size_t len, uint64_t seed) {
+  const uint8_t *end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    const uint8_t *limit = end - 32;
+    do {
+      v1 = xxh_round(v1, load_le64(p)); p += 8;
+      v2 = xxh_round(v2, load_le64(p)); p += 8;
+      v3 = xxh_round(v3, load_le64(p)); p += 8;
+      v4 = xxh_round(v4, load_le64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = xxh_merge(h, v1);
+    h = xxh_merge(h, v2);
+    h = xxh_merge(h, v3);
+    h = xxh_merge(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h ^= xxh_round(0, load_le64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= (uint64_t)load_le32(p) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = rotl64(h, 11) * P1;
+    p++;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+/* ================================================================== */
+/* CRC-32C (Castagnoli, for snappy framing masked checksums)          */
+/* ================================================================== */
+
+static uint32_t crc32c_table[256];
+static int crc32c_ready = 0;
+
+static void crc32c_init(void) {
+  uint32_t i, j, crc;
+  for (i = 0; i < 256; i++) {
+    crc = i;
+    for (j = 0; j < 8; j++)
+      crc = (crc >> 1) ^ (0x82F63B78U & (~(crc & 1) + 1));
+    crc32c_table[i] = crc;
+  }
+  crc32c_ready = 1;
+}
+
+LS_EXPORT uint32_t ls_crc32c(const uint8_t *p, size_t len) {
+  uint32_t crc = 0xFFFFFFFFU;
+  size_t i;
+  if (!crc32c_ready) crc32c_init();
+  for (i = 0; i < len; i++)
+    crc = (crc >> 8) ^ crc32c_table[(crc ^ p[i]) & 0xFF];
+  return crc ^ 0xFFFFFFFFU;
+}
+
+/* ================================================================== */
+/* Snappy raw block format (format_description.txt)                   */
+/* ================================================================== */
+
+static size_t write_uvarint(uint8_t *out, uint64_t n) {
+  size_t i = 0;
+  while (n >= 0x80) {
+    out[i++] = (uint8_t)(n | 0x80);
+    n >>= 7;
+  }
+  out[i++] = (uint8_t)n;
+  return i;
+}
+
+LS_EXPORT size_t ls_snappy_max_compressed(size_t n) {
+  return 32 + n + n / 6;
+}
+
+#define HASH_BITS 14
+#define HASH_SIZE (1u << HASH_BITS)
+
+static inline uint32_t snappy_hash(uint32_t v) {
+  return (v * 0x1e35a7bdU) >> (32 - HASH_BITS);
+}
+
+static uint8_t *emit_literal(uint8_t *op, const uint8_t *lit, size_t len) {
+  size_t n = len - 1;
+  if (n < 60) {
+    *op++ = (uint8_t)(n << 2);
+  } else if (n < 256) {
+    *op++ = 60 << 2;
+    *op++ = (uint8_t)n;
+  } else if (n < 65536) {
+    *op++ = 61 << 2;
+    *op++ = (uint8_t)n;
+    *op++ = (uint8_t)(n >> 8);
+  } else if (n < (1u << 24)) {
+    *op++ = 62 << 2;
+    *op++ = (uint8_t)n;
+    *op++ = (uint8_t)(n >> 8);
+    *op++ = (uint8_t)(n >> 16);
+  } else {
+    *op++ = 63 << 2;
+    *op++ = (uint8_t)n;
+    *op++ = (uint8_t)(n >> 8);
+    *op++ = (uint8_t)(n >> 16);
+    *op++ = (uint8_t)(n >> 24);
+  }
+  memcpy(op, lit, len);
+  return op + len;
+}
+
+static uint8_t *emit_copy_upto64(uint8_t *op, size_t offset, size_t len) {
+  if (len >= 4 && len <= 11 && offset < 2048) {
+    *op++ = (uint8_t)(1 | ((len - 4) << 2) | ((offset >> 8) << 5));
+    *op++ = (uint8_t)offset;
+  } else {
+    *op++ = (uint8_t)(2 | ((len - 1) << 2));
+    *op++ = (uint8_t)offset;
+    *op++ = (uint8_t)(offset >> 8);
+  }
+  return op;
+}
+
+static uint8_t *emit_copy(uint8_t *op, size_t offset, size_t len) {
+  while (len >= 68) {
+    op = emit_copy_upto64(op, offset, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    op = emit_copy_upto64(op, offset, 60);
+    len -= 60;
+  }
+  return emit_copy_upto64(op, offset, len);
+}
+
+LS_EXPORT long ls_snappy_compress(const uint8_t *in, size_t n, uint8_t *out) {
+  uint16_t table[HASH_SIZE];
+  uint8_t *op = out;
+  size_t ip = 0, lit_start = 0, block_start;
+  op += write_uvarint(op, n);
+  /* process in 64 KiB blocks so 16-bit table offsets suffice */
+  for (block_start = 0; block_start < n; block_start += 65536) {
+    size_t block_end = block_start + 65536 < n ? block_start + 65536 : n;
+    memset(table, 0, sizeof(table));
+    ip = block_start;
+    lit_start = block_start;
+    if (block_end - block_start >= 15) {
+      while (ip + 4 <= block_end) {
+        uint32_t v = load_le32(in + ip);
+        uint32_t h = snappy_hash(v);
+        size_t cand = block_start + table[h];
+        table[h] = (uint16_t)(ip - block_start);
+        if (cand < ip && load_le32(in + cand) == v) {
+          size_t len = 4;
+          while (ip + len < block_end && in[cand + len] == in[ip + len]) len++;
+          if (ip > lit_start)
+            op = emit_literal(op, in + lit_start, ip - lit_start);
+          op = emit_copy(op, ip - cand, len);
+          ip += len;
+          lit_start = ip;
+        } else {
+          ip++;
+        }
+      }
+    }
+    if (block_end > lit_start)
+      op = emit_literal(op, in + lit_start, block_end - lit_start);
+  }
+  if (n == 0) { /* empty input: just the varint 0 */ }
+  return (long)(op - out);
+}
+
+static int read_uvarint(const uint8_t *in, size_t n, size_t *pos, uint64_t *out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (*pos < n) {
+    uint8_t b = in[(*pos)++];
+    result |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = result;
+      return 0;
+    }
+    shift += 7;
+    if (shift > 63) return -1;
+  }
+  return -1;
+}
+
+LS_EXPORT long ls_snappy_uncompressed_length(const uint8_t *in, size_t n) {
+  size_t pos = 0;
+  uint64_t len;
+  if (read_uvarint(in, n, &pos, &len) != 0) return -1;
+  return (long)len;
+}
+
+LS_EXPORT long ls_snappy_uncompress(const uint8_t *in, size_t n, uint8_t *out,
+                                    size_t cap) {
+  size_t pos = 0, op = 0;
+  uint64_t expect;
+  if (read_uvarint(in, n, &pos, &expect) != 0) return -1;
+  if (expect > cap) return -1;
+  while (pos < n) {
+    uint8_t tag = in[pos++];
+    uint32_t kind = tag & 3;
+    if (kind == 0) { /* literal */
+      size_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        size_t nb = len - 60, i;
+        if (pos + nb > n) return -1;
+        len = 0;
+        for (i = 0; i < nb; i++) len |= (size_t)in[pos + i] << (8 * i);
+        len += 1;
+        pos += nb;
+      }
+      if (pos + len > n || op + len > expect) return -1;
+      memcpy(out + op, in + pos, len);
+      pos += len;
+      op += len;
+    } else {
+      size_t len, offset;
+      if (kind == 1) {
+        if (pos >= n) return -1;
+        len = ((tag >> 2) & 7) + 4;
+        offset = ((size_t)(tag >> 5) << 8) | in[pos++];
+      } else if (kind == 2) {
+        if (pos + 2 > n) return -1;
+        len = (tag >> 2) + 1;
+        offset = (size_t)in[pos] | ((size_t)in[pos + 1] << 8);
+        pos += 2;
+      } else {
+        if (pos + 4 > n) return -1;
+        len = (tag >> 2) + 1;
+        offset = (size_t)in[pos] | ((size_t)in[pos + 1] << 8) |
+                 ((size_t)in[pos + 2] << 16) | ((size_t)in[pos + 3] << 24);
+        pos += 4;
+      }
+      if (offset == 0 || offset > op || op + len > expect) return -1;
+      {
+        size_t i; /* byte-wise: copies may overlap forward (RLE) */
+        for (i = 0; i < len; i++) out[op + i] = out[op + i - offset];
+      }
+      op += len;
+    }
+  }
+  if (op != expect) return -1;
+  return (long)op;
+}

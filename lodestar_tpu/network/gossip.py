@@ -70,6 +70,38 @@ def compute_message_id(topic: str, raw_message: bytes) -> bytes:
     ).digest()[:20]
 
 
+def fast_message_id(raw_message: bytes) -> bytes:
+    """Cheap pre-validation dedup id (the reference's xxhash-based
+    fastMsgIdFn, test/perf/network/gossip/fastMsgIdFn.test.ts): an
+    xxhash64 of the raw compressed payload, hex-encoded."""
+    from lodestar_tpu import native
+
+    if native.available():
+        return native.xxh64(raw_message).to_bytes(8, "big")
+    return hashlib.sha256(raw_message).digest()[:8]
+
+
+class _BoundedSeen:
+    """Insertion-ordered seen-cache with FIFO eviction (the gossipsub
+    seenCache role; unbounded growth would leak on a long-lived node)."""
+
+    def __init__(self, max_size: int = 1 << 16):
+        from collections import OrderedDict
+
+        self._d = OrderedDict()
+        self.max_size = max_size
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def add(self, key) -> None:
+        if key in self._d:
+            return
+        self._d[key] = None
+        while len(self._d) > self.max_size:
+            self._d.popitem(last=False)
+
+
 @dataclass
 class GossipStats:
     published: int = 0
@@ -86,7 +118,8 @@ class Eth2Gossip:
         self.endpoint = endpoint
         self.fork_digest = fork_digest
         self._queues: Dict[str, JobItemQueue] = {}
-        self._seen_ids: set = set()
+        self._seen_ids = _BoundedSeen()
+        self._seen_fast_ids = _BoundedSeen()
         self.stats = GossipStats()
 
     def _topic(self, gossip_type: GossipType, subnet: Optional[int] = None) -> str:
@@ -99,6 +132,7 @@ class Eth2Gossip:
         topic = self._topic(gossip_type, subnet)
         raw = snappy_compress(ssz_type.serialize(obj))
         self._seen_ids.add(compute_message_id(topic, raw))
+        self._seen_fast_ids.add((topic, fast_message_id(raw)))
         self.stats.published += 1
         return await self.endpoint.publish(topic, raw)
 
@@ -122,6 +156,13 @@ class Eth2Gossip:
         self._queues[topic] = queue
 
         async def on_message(from_peer: str, topic_: str, raw: bytes) -> None:
+            # cheap xxhash first-pass dedup (fastMsgIdFn role) before the
+            # sha256 canonical id — most duplicates never get hashed fully
+            fast_id = (topic_, fast_message_id(raw))
+            if fast_id in self._seen_fast_ids:
+                self.stats.duplicates += 1
+                return
+            self._seen_fast_ids.add(fast_id)
             msg_id = compute_message_id(topic_, raw)
             if msg_id in self._seen_ids:
                 self.stats.duplicates += 1

@@ -18,6 +18,8 @@ from __future__ import annotations
 import hashlib
 from typing import Any, Dict, List as PyList, Optional, Sequence, Tuple
 
+from lodestar_tpu import native as _native
+
 BYTES_PER_CHUNK = 32
 ZERO_CHUNK = b"\x00" * BYTES_PER_CHUNK
 
@@ -27,6 +29,8 @@ for _ in range(64):
     ZERO_HASHES.append(
         hashlib.sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]).digest()
     )
+
+_NATIVE = _native.available()
 
 
 def hash_nodes(a: bytes, b: bytes) -> bytes:
@@ -53,10 +57,16 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> by
     if limit == 1:
         return bytes(chunks[0]) if count else ZERO_CHUNK
     depth = limit.bit_length() - 1
+    if count == 0:
+        return ZERO_HASHES[depth]
+    if _NATIVE:
+        # one native call per layer (the as-sha256 batched-hash role)
+        buf = b"".join(bytes(c) for c in chunks)
+        for level in range(depth):
+            buf = _native.hash_layer(buf, ZERO_HASHES[level])
+        return buf
     layer = [bytes(c) for c in chunks]
     for level in range(depth):
-        if len(layer) == 0:
-            return ZERO_HASHES[depth]
         nxt = []
         for i in range(0, len(layer) - 1, 2):
             nxt.append(hash_nodes(layer[i], layer[i + 1]))
@@ -524,8 +534,12 @@ class ContainerMeta(type):
         return cls(**kwargs)
 
     def hash_tree_root(cls, value) -> bytes:
-        roots = [t.hash_tree_root(getattr(value, n)) for n, t in cls._fields_.items()]
-        return merkleize_chunks(roots)
+        return merkleize_chunks(cls.field_roots(value))
+
+    def field_roots(cls, value) -> PyList[bytes]:
+        """Per-field subtree roots — the container's merkle leaves (used
+        by ssz/proof.py for light-client branches)."""
+        return [t.hash_tree_root(getattr(value, n)) for n, t in cls._fields_.items()]
 
 
 class Container(metaclass=ContainerMeta):

@@ -64,6 +64,9 @@ class JobItemQueue(Generic[T, R]):
         self.metrics = QueueMetrics()
         self._tasks: set = set()
 
+    def __len__(self) -> int:
+        return len(self._items)
+
     def push(self, item: T) -> "asyncio.Future[R]":
         if self._aborted:
             raise QueueAbortedError(self.name)

@@ -13,6 +13,10 @@ from __future__ import annotations
 import struct
 from typing import Iterator, List, Tuple
 
+from lodestar_tpu import native as _native
+
+_NATIVE = _native.available()
+
 # ---------------------------------------------------------------------------
 # varint
 # ---------------------------------------------------------------------------
@@ -54,7 +58,17 @@ _MAX_LITERAL = 60  # tag-encoded literal lengths 1..60
 
 
 def compress(data: bytes) -> bytes:
-    """Literal-only Snappy block (valid per format spec §2.1)."""
+    """Snappy block compression.
+
+    Native path (lodestar_tpu/native): real LZ77 matching, the role of the
+    reference's C snappy.  Fallback: literal-only blocks (valid per format
+    spec §2.1), trading ratio for simplicity."""
+    if _NATIVE:
+        return _native.snappy_compress(bytes(data))
+    return _py_compress(data)
+
+
+def _py_compress(data: bytes) -> bytes:
     out = bytearray(_write_uvarint(len(data)))
     pos = 0
     n = len(data)
@@ -75,6 +89,15 @@ def compress(data: bytes) -> bytes:
 
 
 def decompress(data: bytes) -> bytes:
+    if _NATIVE:
+        try:
+            return _native.snappy_uncompress(bytes(data))
+        except ValueError as e:
+            raise ValueError(f"corrupt snappy block: {e}") from e
+    return _py_decompress(data)
+
+
+def _py_decompress(data: bytes) -> bytes:
     expected_len, pos = _read_uvarint(data, 0)
     out = bytearray()
     n = len(data)
@@ -146,6 +169,8 @@ def _crc_table() -> List[int]:
 
 
 def crc32c(data: bytes) -> int:
+    if _NATIVE:
+        return _native.crc32c(bytes(data))
     tbl = _crc_table()
     crc = 0xFFFFFFFF
     for b in data:

@@ -1,0 +1,72 @@
+"""Metrics subsystem tests (reference: metrics/metrics.ts createMetrics,
+validatorMonitor.ts, metrics/server/).
+"""
+import asyncio
+
+import pytest
+
+from lodestar_tpu.metrics import Metrics
+from lodestar_tpu.metrics.server import HttpMetricsServer
+
+
+class TestRegistry:
+    def test_expose_contains_groups(self):
+        m = Metrics()
+        m.beacon.head_slot.set(42)
+        m.lodestar.block_import_seconds.observe(0.123)
+        text = m.expose().decode()
+        assert "beacon_head_slot 42.0" in text
+        assert "lodestar_tpu_block_import_seconds_bucket" in text
+
+    def test_instances_are_isolated(self):
+        a, b = Metrics(), Metrics()
+        a.beacon.head_slot.set(1)
+        b.beacon.head_slot.set(2)
+        assert "beacon_head_slot 1.0" in a.expose().decode()
+        assert "beacon_head_slot 2.0" in b.expose().decode()
+
+
+class TestValidatorMonitor:
+    def test_tracked_attestation_flow(self):
+        m = Metrics()
+        vm = m.validator_monitor
+        vm.register_validator(7)
+        vm.on_gossip_attestation(7, target_epoch=3, delay_sec=0.4)
+        vm.on_attestation_in_block(7, target_epoch=3, inclusion_distance=2)
+        # untracked indices are ignored
+        vm.on_gossip_attestation(99, target_epoch=3, delay_sec=0.1)
+        s = vm.epoch_summary(7, 3)
+        assert s.attestations_seen == 2
+        assert s.attestation_included
+        assert s.attestation_inclusion_distance == 2
+        assert vm.epoch_summary(99, 3) is None
+        vm.prune(before_epoch=4)
+        assert vm.epoch_summary(7, 3) is None
+
+    def test_block_proposal(self):
+        m = Metrics()
+        vm = m.validator_monitor
+        vm.register_validator(1)
+        vm.on_block_imported(1, epoch=5)
+        assert vm.epoch_summary(1, 5).blocks_proposed == 1
+
+
+class TestHttpServer:
+    def test_scrape_endpoint(self):
+        async def run():
+            m = Metrics()
+            m.beacon.clock_slot.set(9)
+            srv = HttpMetricsServer(m, port=18008)
+            await srv.start()
+            try:
+                import aiohttp
+
+                async with aiohttp.ClientSession() as s:
+                    async with s.get("http://127.0.0.1:18008/metrics") as resp:
+                        assert resp.status == 200
+                        body = await resp.text()
+                        assert "beacon_clock_slot 9.0" in body
+            finally:
+                await srv.close()
+
+        asyncio.run(run())

@@ -1,0 +1,127 @@
+"""Native C kernel tests: differential vs hashlib / pure-Python fallbacks.
+
+The native library fills the reference's native-dep roles (SURVEY §2.3):
+as-sha256 (merkleization), xxhash-wasm (gossip msg ids), snappy + CRC-32C
+(wire compression/framing).  Known-answer vectors guard the from-scratch
+implementations; interop tests pin wire compatibility between the C codec
+and the pure-Python fallback.
+"""
+import hashlib
+import os
+import random
+
+import pytest
+
+from lodestar_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no cc)"
+)
+
+
+class TestSha256:
+    def test_differential_vs_hashlib(self):
+        for n in (0, 1, 31, 32, 55, 56, 63, 64, 65, 127, 128, 1000, 9999):
+            d = os.urandom(n)
+            assert native.sha256(d) == hashlib.sha256(d).digest(), n
+
+    def test_hash_pairs(self):
+        d = os.urandom(64 * 17)
+        want = b"".join(
+            hashlib.sha256(d[i : i + 64]).digest() for i in range(0, len(d), 64)
+        )
+        assert native.hash_pairs(d) == want
+
+    def test_hash_layer_odd_tail(self):
+        nodes = os.urandom(32 * 5)
+        zero = os.urandom(32)
+        got = native.hash_layer(nodes, zero)
+        want = (
+            hashlib.sha256(nodes[0:64]).digest()
+            + hashlib.sha256(nodes[64:128]).digest()
+            + hashlib.sha256(nodes[128:160] + zero).digest()
+        )
+        assert got == want
+
+
+class TestXxh64:
+    def test_known_vectors(self):
+        assert native.xxh64(b"") == 0xEF46DB3751D8E999
+        assert native.xxh64(b"abc") == 0x44BC2CF5AD770999
+
+    def test_seed_changes_hash(self):
+        assert native.xxh64(b"abc", 1) != native.xxh64(b"abc", 0)
+
+
+class TestCrc32c:
+    def test_check_value(self):
+        # the canonical CRC-32C check value
+        assert native.crc32c(b"123456789") == 0xE3069283
+
+    def test_matches_python_fallback(self):
+        from lodestar_tpu.utils.snappy import _crc_table
+
+        tbl = _crc_table()
+
+        def py_crc(data):
+            crc = 0xFFFFFFFF
+            for b in data:
+                crc = tbl[(crc ^ b) & 0xFF] ^ (crc >> 8)
+            return crc ^ 0xFFFFFFFF
+
+        for n in (0, 1, 100, 1000):
+            d = os.urandom(n)
+            assert native.crc32c(d) == py_crc(d)
+
+
+class TestSnappy:
+    CASES = [
+        b"",
+        b"a",
+        b"ab" * 40000,
+        bytes(100000),
+        b"the quick brown fox jumps over the lazy dog " * 3000,
+    ]
+
+    def test_round_trip(self):
+        random.seed(1234)
+        cases = self.CASES + [bytes(random.getrandbits(8) for _ in range(50000))]
+        for d in cases:
+            c = native.snappy_compress(d)
+            assert native.snappy_uncompress(c) == d
+
+    def test_interop_with_python_codec(self):
+        """C-compressed decodes with the pure-Python decompressor and vice
+        versa (wire compatibility with any conformant snappy peer)."""
+        from lodestar_tpu.utils import snappy as pysnappy
+
+        for d in self.CASES:
+            assert pysnappy._py_decompress(native.snappy_compress(d)) == d
+            assert native.snappy_uncompress(pysnappy._py_compress(d)) == d
+
+    def test_compresses_repetitive_data(self):
+        d = b"deadbeef" * 10000
+        # copies are capped at 64 bytes/3-byte tag -> best case ~21x
+        assert len(native.snappy_compress(d)) < len(d) // 15
+
+    def test_rejects_corrupt(self):
+        c = bytearray(native.snappy_compress(b"hello world, hello world"))
+        c[0] ^= 0x7F  # break the length varint
+        with pytest.raises(ValueError):
+            native.snappy_uncompress(bytes(c))
+
+
+class TestSszWiring:
+    def test_merkleize_matches_fallback(self):
+        from lodestar_tpu.ssz import core
+
+        chunks = [os.urandom(32) for _ in range(7)]
+        native_root = core.merkleize_chunks(chunks, limit=16)
+        # recompute with the pure-python path
+        saved = core._NATIVE
+        core._NATIVE = False
+        try:
+            py_root = core.merkleize_chunks(chunks, limit=16)
+        finally:
+            core._NATIVE = saved
+        assert native_root == py_root
