@@ -53,6 +53,10 @@ class ApiClient:
 
     # beacon -----------------------------------------------------------
 
+    async def get_json(self, path: str) -> dict:
+        """Generic GET returning the route's `data` payload."""
+        return (await self._get(path))["data"]
+
     async def get_genesis(self) -> dict:
         return (await self._get("/eth/v1/beacon/genesis"))["data"]
 

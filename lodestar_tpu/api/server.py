@@ -39,7 +39,8 @@ def _err(code: int, message: str) -> web.Response:
 class BeaconRestApiServer:
     """chain+db+network -> HTTP (BeaconRestApiServer role)."""
 
-    def __init__(self, chain, db, network=None, sync=None):
+    def __init__(self, chain, db, network=None, sync=None, light_client_server=None):
+        self.light_client_server = light_client_server
         self.chain = chain
         self.db = db
         self.network = network
@@ -91,6 +92,19 @@ class BeaconRestApiServer:
         r.add_get("/eth/v1/validator/attestation_data", self.produce_attestation_data)
         r.add_get("/eth/v1/validator/aggregate_attestation", self.get_aggregate)
         r.add_post("/eth/v1/validator/aggregate_and_proofs", self.post_aggregate_and_proofs)
+        # light client (beacon/routes/lightclient.ts)
+        r.add_get(
+            "/eth/v1/beacon/light_client/bootstrap/{block_root}",
+            self.get_lc_bootstrap,
+        )
+        r.add_get("/eth/v1/beacon/light_client/updates", self.get_lc_updates)
+        r.add_get(
+            "/eth/v1/beacon/light_client/finality_update", self.get_lc_finality_update
+        )
+        r.add_get(
+            "/eth/v1/beacon/light_client/optimistic_update",
+            self.get_lc_optimistic_update,
+        )
         # events + debug
         r.add_get("/eth/v1/events", self.get_events)
         r.add_get("/eth/v1/debug/beacon/heads", self.get_debug_heads)
@@ -493,7 +507,11 @@ class BeaconRestApiServer:
             pre.state
         )
         g = graffiti.encode()[:32].ljust(32, b"\x00") if isinstance(graffiti, str) else graffiti
-        body = ssz.phase0.BeaconBlockBody(
+        from lodestar_tpu.types import fork_of_state, types_for
+
+        fork = fork_of_state(pre.state)
+        _, block_t, signed_t, body_t = types_for(fork)
+        body = body_t(
             randao_reveal=randao_reveal,
             eth1_data=pre.state.eth1_data,
             graffiti=g,
@@ -502,6 +520,12 @@ class BeaconRestApiServer:
             attestations=atts,
             voluntary_exits=exits,
         )
+        if hasattr(body, "sync_aggregate"):
+            # assemble from the contribution pool (produceBlockBody.ts
+            # syncAggregate from SyncContributionAndProofPool)
+            body.sync_aggregate = self.chain.sync_contribution_pool.get_sync_aggregate(
+                slot, self.chain.head_root
+            )
         hdr = head_state.state.latest_block_header
         parent_hdr = ssz.phase0.BeaconBlockHeader(
             slot=hdr.slot, proposer_index=hdr.proposer_index,
@@ -510,14 +534,14 @@ class BeaconRestApiServer:
         )
         if bytes(parent_hdr.state_root) == b"\x00" * 32:
             parent_hdr.state_root = head_state.hash_tree_root()
-        block = ssz.phase0.BeaconBlock(
+        block = block_t(
             slot=slot,
             proposer_index=proposer,
             parent_root=ssz.phase0.BeaconBlockHeader.hash_tree_root(parent_hdr),
             state_root=b"\x00" * 32,
             body=body,
         )
-        trial = ssz.phase0.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
+        trial = signed_t(message=block, signature=b"\x00" * 96)
         post = state_transition(
             self.chain.get_head_state(), trial,
             verify_state_root=False, verify_proposer=False, verify_signatures=False,
@@ -664,3 +688,45 @@ class BeaconRestApiServer:
     async def close(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+
+
+    # ------------------------------------------------------------------
+    # light client routes (api/impl/lightclient)
+    # ------------------------------------------------------------------
+
+    async def get_lc_bootstrap(self, request):
+        if self.light_client_server is None:
+            return _err(501, "light client server not enabled")
+        root = bytes.fromhex(request.match_info["block_root"].replace("0x", ""))
+        bs = self.light_client_server.get_bootstrap(root)
+        if bs is None:
+            return _err(404, "no bootstrap for that root")
+        return _ok(to_json(ssz.altair.LightClientBootstrap, bs))
+
+    async def get_lc_updates(self, request):
+        if self.light_client_server is None:
+            return _err(501, "light client server not enabled")
+        start = int(request.query.get("start_period", 0))
+        count = min(128, int(request.query.get("count", 1)))
+        out = []
+        for period in range(start, start + count):
+            u = self.light_client_server.get_update(period)
+            if u is not None:
+                out.append(to_json(ssz.altair.LightClientUpdate, u))
+        return _ok(out)
+
+    async def get_lc_finality_update(self, request):
+        if self.light_client_server is None:
+            return _err(501, "light client server not enabled")
+        u = self.light_client_server.latest_finality_update
+        if u is None:
+            return _err(404, "no finality update yet")
+        return _ok(to_json(ssz.altair.LightClientFinalityUpdate, u))
+
+    async def get_lc_optimistic_update(self, request):
+        if self.light_client_server is None:
+            return _err(501, "light client server not enabled")
+        u = self.light_client_server.latest_optimistic_update
+        if u is None:
+            return _err(404, "no optimistic update yet")
+        return _ok(to_json(ssz.altair.LightClientOptimisticUpdate, u))

@@ -1,0 +1,85 @@
+"""Archiver: hot -> cold migration on finalization (reference:
+packages/beacon-node/src/chain/archiver/ — archiveBlocks.ts,
+archiveStates.ts).
+
+On each finalized-checkpoint event the canonical chain up to the
+finalized slot moves from the hot by-root block repo into the by-slot
+block archive; non-canonical (pruned fork) blocks are dropped, the
+finalized state is persisted to the state archive, and fork choice +
+state caches are pruned.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+
+class Archiver:
+    def __init__(self, chain, states_per_archive_epochs: int = 1):
+        from .chain import ChainEvent
+
+        self.chain = chain
+        self.states_per_archive_epochs = states_per_archive_epochs
+        self._last_archived_slot = -1
+        chain.on(ChainEvent.finalized, self.on_finalized)
+
+    # ------------------------------------------------------------------
+
+    def on_finalized(self, checkpoint) -> None:
+        chain = self.chain
+        db = chain.db
+        fin_root = bytes.fromhex(checkpoint.root[2:])
+        fin_block = db.block.get(fin_root)
+        if fin_block is None:
+            return
+        fin_slot = fin_block.message.slot
+
+        # walk the canonical chain backwards from the finalized block
+        canonical: List[tuple] = []
+        root = fin_root
+        while True:
+            signed = db.block.get(root)
+            if signed is None:
+                break
+            slot = signed.message.slot
+            if slot <= self._last_archived_slot:
+                break
+            canonical.append((slot, root, signed))
+            parent = bytes(signed.message.parent_root)
+            if parent == root or slot == 0:
+                break
+            root = parent
+
+        # cold store: by-slot archive + root index (archiveBlocks.ts)
+        for slot, root_, signed in reversed(canonical):
+            db.block_archive.put(slot, signed)
+            db.block_archive_root_index.put(root_, slot)
+
+        # archive the finalized state if cached (archiveStates.ts)
+        st = chain.state_cache.get(fin_root)
+        if st is not None:
+            db.state_archive.put(st.state.slot, st.state)
+            db.state_archive_root_index.put(fin_root, st.state.slot)
+
+        # prune fork choice and drop non-canonical hot blocks below the
+        # finalized slot
+        pruned = chain.fork_choice.prune(checkpoint.root)
+        keep = {r for _, r, _ in canonical}
+        for node in pruned:
+            r = bytes.fromhex(node.block_root[2:])
+            if r not in keep and r != fin_root:
+                db.block.delete(r)
+
+        self._last_archived_slot = fin_slot
+
+    # queries (blockArchive consumers: byRange sync, API) ---------------
+
+    def get_archived_block(self, slot: int):
+        return self.chain.db.block_archive.get(slot)
+
+    def get_archived_block_by_root(self, root: bytes):
+        slot = self.chain.db.block_archive_root_index.get(root)
+        if slot is None:
+            return None
+        return self.chain.db.block_archive.get(slot)

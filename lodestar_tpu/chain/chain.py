@@ -28,12 +28,19 @@ from lodestar_tpu.types import ssz
 from lodestar_tpu.utils.queue import JobItemQueue, QueueType
 from .bls import BlsVerifier, SingleThreadBlsVerifier, VerifyOptions
 from .clock import LocalClock
-from .op_pools import AggregatedAttestationPool, AttestationPool, OpPool
+from .op_pools import (
+    AggregatedAttestationPool,
+    AttestationPool,
+    OpPool,
+    SyncCommitteeMessagePool,
+    SyncContributionAndProofPool,
+)
 from .regen import CheckpointStateCache, StateContextCache, StateRegenerator
 from .seen_cache import (
     SeenAggregatedAttestations,
     SeenAttesters,
     SeenBlockProposers,
+    SeenSyncCommitteeMessages,
 )
 from lodestar_tpu.fork_choice import (
     CheckpointHex,
@@ -63,8 +70,34 @@ def compute_unrealized_checkpoints(cfg, cached: CachedBeaconState):
     """What justification/finalization WOULD be if the epoch ended now
     (reference computeUnrealizedCheckpoints, used for fork-choice
     viability).  Runs the flag sweep + a non-mutating weigh pass."""
+    from lodestar_tpu.types import fork_of_state
+    from lodestar_tpu.params import ForkName
+
     state = cached.state
-    proc = before_process_epoch(cfg, state, cached.epoch_ctx)
+    if fork_of_state(state) is ForkName.phase0:
+        proc = before_process_epoch(cfg, state, cached.epoch_ctx)
+        from lodestar_tpu.state_transition.epoch.phase0 import (
+            FLAG_CURR_TARGET,
+            FLAG_PREV_TARGET,
+            _unslashed_attesting_balance,
+        )
+
+        prev_target = _unslashed_attesting_balance(proc, FLAG_PREV_TARGET)
+        curr_target = _unslashed_attesting_balance(proc, FLAG_CURR_TARGET)
+    else:
+        from lodestar_tpu.params import TIMELY_TARGET_FLAG_INDEX
+        from lodestar_tpu.state_transition.epoch.altair import (
+            _unslashed_participating_balance,
+            before_process_epoch as before_altair,
+        )
+
+        proc = before_altair(cfg, state, cached.epoch_ctx)
+        prev_target = _unslashed_participating_balance(
+            proc, TIMELY_TARGET_FLAG_INDEX, previous=True
+        )
+        curr_target = _unslashed_participating_balance(
+            proc, TIMELY_TARGET_FLAG_INDEX, previous=False
+        )
     if proc.current_epoch <= 1:
         return state.current_justified_checkpoint, state.finalized_checkpoint
 
@@ -81,18 +114,9 @@ def compute_unrealized_checkpoints(cfg, cached: CachedBeaconState):
     sh.finalized_checkpoint = state.finalized_checkpoint
     sh.justification_bits = list(state.justification_bits)
     sh.block_roots = state.block_roots
-    from lodestar_tpu.state_transition.epoch.phase0 import (
-        FLAG_CURR_TARGET,
-        FLAG_PREV_TARGET,
-        _unslashed_attesting_balance,
-    )
 
     weigh_justification_and_finalization(
-        cfg,
-        sh,
-        proc.total_active_balance,
-        _unslashed_attesting_balance(proc, FLAG_PREV_TARGET),
-        _unslashed_attesting_balance(proc, FLAG_CURR_TARGET),
+        cfg, sh, proc.total_active_balance, prev_target, curr_target
     )
     return sh.current_justified_checkpoint, sh.finalized_checkpoint
 
@@ -106,11 +130,13 @@ class BeaconChain:
         verifier: Optional[BlsVerifier] = None,
         execution_engine=None,
         clock: Optional[LocalClock] = None,
+        metrics=None,
     ):
         self.cfg = cfg
         self.db = db
         self.bls = verifier or SingleThreadBlsVerifier()
         self.execution_engine = execution_engine
+        self.metrics = metrics  # lodestar_tpu.metrics.Metrics or None
         anchor = CachedBeaconState(cfg, anchor_state)
         self.genesis_time = anchor_state.genesis_time
         self.genesis_validators_root = bytes(anchor_state.genesis_validators_root)
@@ -132,7 +158,15 @@ class BeaconChain:
         self.state_cache = StateContextCache()
         self.checkpoint_state_cache = CheckpointStateCache()
         self.state_cache.add(anchor_root, anchor)
-        self.regen = StateRegenerator(self.state_cache, self.db.block.get)
+        self.state_cache.pin(anchor_root)  # regen's terminal ancestor
+        self._pinned_finalized_root = anchor_root
+        self.regen = StateRegenerator(
+            self.state_cache,
+            self.db.block.get,
+            on_miss=(
+                self.metrics.lodestar.regen_requests.inc if self.metrics else None
+            ),
+        )
 
         # fork choice
         fin = anchor_state.finalized_checkpoint
@@ -167,16 +201,23 @@ class BeaconChain:
             unrealized_justified=anchor_cp,
             unrealized_finalized=anchor_cp,
         )
-        self.fork_choice = ForkChoice(cfg, store, proto)
+        self.fork_choice = ForkChoice(
+            cfg, store, proto,
+            justified_balances_getter=self._get_justified_balances,
+        )
 
         # pools + dedup caches
         self.attestation_pool = AttestationPool()
         self.aggregated_attestation_pool = AggregatedAttestationPool()
+        self.sync_committee_message_pool = SyncCommitteeMessagePool()
+        self.sync_contribution_pool = SyncContributionAndProofPool()
         self.op_pool = OpPool()
         self.seen_attesters = SeenAttesters()
         self.seen_aggregators = SeenAttesters()
         self.seen_aggregated_attestations = SeenAggregatedAttestations()
         self.seen_block_proposers = SeenBlockProposers()
+        self.seen_sync_committee_messages = SeenSyncCommitteeMessages()
+        self.seen_sync_contributions = SeenSyncCommitteeMessages()
 
         # block pipeline
         self.block_queue: JobItemQueue = JobItemQueue(
@@ -188,7 +229,9 @@ class BeaconChain:
         )
         self._event_handlers: Dict[ChainEvent, List[Callable]] = {}
         self.head_root: bytes = anchor_root
-        self.db.block.put(anchor_root, _genesis_signed_block(anchor_hdr))
+        self.db.block.put(
+            anchor_root, _genesis_signed_block(anchor_hdr, anchor_state)
+        )
 
     # ------------------------------------------------------------------
     # events
@@ -212,7 +255,7 @@ class BeaconChain:
 
     async def _process_block_job(self, signed_block) -> bytes:
         block = signed_block.message
-        root = ssz.phase0.BeaconBlock.hash_tree_root(block)
+        root = type(block).hash_tree_root(block)
 
         # sanity checks (verifyBlocksSanityChecks.ts)
         if self.db.block.has(root):
@@ -229,6 +272,7 @@ class BeaconChain:
 
         pre_state = self.regen.get_pre_state(parent_root, block.slot)
         received_at = time.time()
+        t_start = time.perf_counter()
 
         # 3-way parallel verify (verifyBlock.ts:71-80): execution payload ∥
         # state transition ∥ signature sets
@@ -243,11 +287,15 @@ class BeaconChain:
             return await self.execution_engine.notify_new_payload(payload)
 
         def run_stf():
-            return state_transition(
+            t0 = time.perf_counter()
+            post = state_transition(
                 pre_state, signed_block,
                 verify_state_root=True, verify_proposer=False,
                 verify_signatures=False,
             )
+            if self.metrics:
+                self.metrics.lodestar.stfn_seconds.observe(time.perf_counter() - t0)
+            return post
 
         async def verify_signatures():
             sets = get_block_signature_sets(
@@ -255,9 +303,15 @@ class BeaconChain:
             )
             if not sets:
                 return True
-            return await self.bls.verify_signature_sets(
+            t0 = time.perf_counter()
+            ok = await self.bls.verify_signature_sets(
                 sets, VerifyOptions(batchable=True)
             )
+            if self.metrics:
+                self.metrics.lodestar.block_sig_verify_seconds.observe(
+                    time.perf_counter() - t0
+                )
+            return ok
 
         payload_res, post_state, sigs_ok = await asyncio.gather(
             verify_payload(),
@@ -270,6 +324,12 @@ class BeaconChain:
             raise ValueError("block signatures invalid")
 
         self._import_block(signed_block, root, post_state, received_at)
+        if self.metrics:
+            self.metrics.lodestar.block_import_seconds.observe(
+                time.perf_counter() - t_start
+            )
+            self.metrics.lodestar.block_queue_length.set(len(self.block_queue))
+            self.metrics.lodestar.state_cache_size.set(len(self.state_cache))
         return root
 
     def _import_block(self, signed_block, root, post_state, received_at) -> None:
@@ -323,15 +383,13 @@ class BeaconChain:
                 st.finalized_checkpoint.epoch,
                 _hex(bytes(st.finalized_checkpoint.root)),
             ),
-            justified_balances=list(post_state.epoch_ctx.effective_balance_increments),
         )
-        # register the block's attestations as LMD votes
+        # register the block's attestations as LMD votes (+ the validator
+        # monitor's inclusion tracking, sharing the committee resolution)
+        from lodestar_tpu.state_transition.block.phase0 import get_attesting_indices
+
         for att in block.body.attestations:
             try:
-                from lodestar_tpu.state_transition.block.phase0 import (
-                    get_attesting_indices,
-                )
-
                 indices = get_attesting_indices(
                     post_state.epoch_ctx, att.data, att.aggregation_bits
                 )
@@ -340,12 +398,29 @@ class BeaconChain:
                     _hex(bytes(att.data.beacon_block_root)),
                     att.data.target.epoch,
                 )
+                if self.metrics:
+                    dist = max(1, block.slot - att.data.slot)
+                    for idx in indices:
+                        self.metrics.validator_monitor.on_attestation_in_block(
+                            int(idx), att.data.target.epoch, dist
+                        )
             except Exception:
                 continue  # vote outside cached shufflings — skip
 
+        old_head_root = self.head_root
         head = self.fork_choice.update_head()
         self.head_root = bytes.fromhex(head.block_root[2:])
         self.seen_block_proposers.add(block.slot, block.proposer_index)
+        if self.metrics:
+            m = self.metrics
+            m.beacon.head_slot.set(head.slot)
+            m.beacon.current_justified_epoch.set(self.fork_choice.store.justified.epoch)
+            m.beacon.finalized_epoch.set(self.fork_choice.store.finalized.epoch)
+            m.beacon.proposed_blocks_total.inc()
+            # reorg: the previous head is no longer an ancestor of the head
+            if not self.fork_choice.is_descendant(_hex(old_head_root), head.block_root):
+                m.beacon.reorgs_total.inc()
+            m.validator_monitor.on_block_imported(block.proposer_index, epoch)
 
         self._emit(ChainEvent.block, signed_block, root)
         self._emit(ChainEvent.head, self.head_root)
@@ -354,14 +429,61 @@ class BeaconChain:
             self._emit(ChainEvent.justified, store.justified)
         if store.finalized.epoch > old_fin:
             self._emit(ChainEvent.finalized, store.finalized)
+            # move the regen terminal pin to the new finalized state
+            fin_root = bytes.fromhex(store.finalized.root[2:])
+            if self.state_cache.get(fin_root) is not None:
+                self.state_cache.pin(fin_root)
+                if self._pinned_finalized_root != fin_root:
+                    self.state_cache.unpin(self._pinned_finalized_root)
+                    self._pinned_finalized_root = fin_root
             fin_epoch = store.finalized.epoch
             self.seen_attesters.prune(fin_epoch)
             self.seen_aggregators.prune(fin_epoch)
             self.seen_aggregated_attestations.prune(fin_epoch)
             self.attestation_pool.prune(self.clock.current_slot)
             self.aggregated_attestation_pool.prune(self.clock.current_slot)
+            self.sync_committee_message_pool.prune(self.clock.current_slot)
+            self.sync_contribution_pool.prune(self.clock.current_slot)
+            fin_slot = fin_epoch * _p.SLOTS_PER_EPOCH
+            self.seen_sync_committee_messages.prune(fin_slot)
+            self.seen_sync_contributions.prune(fin_slot)
 
     # ------------------------------------------------------------------
+
+    def get_checkpoint_state(
+        self, epoch: int, root: bytes
+    ) -> Optional[CachedBeaconState]:
+        """State of checkpoint (epoch, block root): the block's post-state
+        dialed forward to the epoch's first slot (regen.getCheckpointState).
+        Used for attestation-shuffling resolution and justified balances."""
+        st = self.checkpoint_state_cache.get(epoch, root)
+        if st is not None:
+            return st
+        base = self.state_cache.get(root)
+        if base is None:
+            try:
+                base = self.regen._replay_to(root)
+            except Exception:
+                return None
+        boundary_slot = epoch * _p.SLOTS_PER_EPOCH
+        if base.state.slot < boundary_slot:
+            from lodestar_tpu.state_transition import process_slots
+
+            base = base.clone()
+            process_slots(base, boundary_slot)
+        self.checkpoint_state_cache.add(epoch, root, base)
+        return base
+
+    def _get_justified_balances(self, checkpoint) -> Optional[List[int]]:
+        """Effective-balance increments of the justified checkpoint's state
+        (the reference's justifiedBalancesGetter).  Called by ForkChoice on
+        every justified change, including the balance-less on-tick pull-up."""
+        st = self.get_checkpoint_state(
+            checkpoint.epoch, bytes.fromhex(checkpoint.root[2:])
+        )
+        if st is None:
+            return None
+        return list(st.epoch_ctx.effective_balance_increments)
 
     def get_head_state(self) -> CachedBeaconState:
         st = self.state_cache.get(self.head_root)
@@ -374,10 +496,13 @@ class BeaconChain:
         await self.bls.close()
 
 
-def _genesis_signed_block(anchor_hdr) -> "ssz.phase0.SignedBeaconBlock":
+def _genesis_signed_block(anchor_hdr, anchor_state):
     """Placeholder stored block for the anchor root so regen can stop
     there; body is empty (the anchor state itself is the source of truth)."""
-    b = ssz.phase0.SignedBeaconBlock.default()
+    from lodestar_tpu.types import fork_of_state, types_for
+
+    _, _, signed_type, _ = types_for(fork_of_state(anchor_state))
+    b = signed_type.default()
     b.message.slot = anchor_hdr.slot
     b.message.proposer_index = anchor_hdr.proposer_index
     b.message.parent_root = bytes(anchor_hdr.parent_root)

@@ -126,13 +126,19 @@ class DevChain:
             if a.data.slot + _p.MIN_ATTESTATION_INCLUSION_DELAY <= slot <= a.data.slot + _p.SLOTS_PER_EPOCH
         ][: _p.MAX_ATTESTATIONS]
 
-        body = ssz.phase0.BeaconBlockBody(
+        from lodestar_tpu.types import fork_of_state, types_for
+
+        fork = fork_of_state(pre.state)
+        _, block_t, signed_t, body_t = types_for(fork)
+        body = body_t(
             randao_reveal=randao_reveal,
             eth1_data=pre.state.eth1_data,
             graffiti=b"lodestar-tpu-dev".ljust(32, b"\x00"),
             attestations=atts,
         )
-        block = ssz.phase0.BeaconBlock(
+        if hasattr(body, "sync_aggregate"):
+            body.sync_aggregate = self._make_sync_aggregate(pre, slot)
+        block = block_t(
             slot=slot,
             proposer_index=proposer,
             parent_root=self._head_root(),
@@ -140,7 +146,7 @@ class DevChain:
             body=body,
         )
         # compute the post-state root (produceBlock/computeNewStateRoot.ts)
-        trial = ssz.phase0.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
+        trial = signed_t(message=block, signature=b"\x00" * 96)
         post = state_transition(
             self.head,
             trial,
@@ -152,9 +158,31 @@ class DevChain:
 
         domain = get_domain(self.cfg, pre.state, DOMAIN_BEACON_PROPOSER, epoch)
         sig = sk.sign(
-            compute_signing_root(ssz.phase0.BeaconBlock, block, domain)
+            compute_signing_root(block_t, block, domain)
         ).to_bytes()
-        return ssz.phase0.SignedBeaconBlock(message=block, signature=sig)
+        return signed_t(message=block, signature=sig)
+
+    def _make_sync_aggregate(self, pre: CachedBeaconState, slot: int):
+        """Full-participation SyncAggregate over the previous slot's block
+        root, signed by the interop keys of the current sync committee."""
+        from lodestar_tpu.params import DOMAIN_SYNC_COMMITTEE
+
+        st = pre.state
+        previous_slot = max(1, slot) - 1
+        root = get_block_root_at_slot(st, previous_slot)
+        domain = get_domain(
+            self.cfg, st, DOMAIN_SYNC_COMMITTEE, compute_epoch_at_slot(previous_slot)
+        )
+        signing_root = compute_signing_root(ssz.phase0.Root, root, domain)
+        indices = [
+            pre.epoch_ctx.pubkey2index[bytes(pk)]
+            for pk in st.current_sync_committee.pubkeys
+        ]
+        sigs = [self.sks[i].sign(signing_root) for i in indices]
+        return ssz.altair.SyncAggregate(
+            sync_committee_bits=[True] * _p.SYNC_COMMITTEE_SIZE,
+            sync_committee_signature=bls.aggregate_signatures(sigs).to_bytes(),
+        )
 
     def import_block(
         self, signed_block, verifier=None, verify_signatures: bool = True
@@ -185,7 +213,8 @@ class DevChain:
                 pre, signed_block, verify_state_root=True,
                 verify_proposer=False, verify_signatures=False,
             )
-        root = ssz.phase0.BeaconBlock.hash_tree_root(signed_block.message)
+        msg = signed_block.message
+        root = type(msg).hash_tree_root(msg)
         imported = ImportedBlock(root=root, block=signed_block, post_state=post)
         self.blocks[root] = imported
         self.head = post

@@ -1,0 +1,147 @@
+"""LightClientServer (reference:
+packages/beacon-node/src/chain/lightClient/index.ts:159 + proofs.ts).
+
+Consumes imported blocks: whenever a block's sync aggregate attests its
+parent with enough participation, the server materializes
+LightClientUpdate data from the attested state — header, next sync
+committee + branch, finalized header + finality branch — keeps the BEST
+update per sync-committee period (most participation, finalized preferred),
+and serves bootstrap/finality/optimistic artifacts to the REST routes and
+reqresp handlers.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+from lodestar_tpu.ssz.proof import container_field_proof
+from lodestar_tpu.types import ssz
+from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+
+
+def sync_period_at_slot(slot: int) -> int:
+    return (
+        compute_epoch_at_slot(slot) // _p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    )
+
+
+def block_to_header(block) -> "ssz.phase0.BeaconBlockHeader":
+    return ssz.phase0.BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=bytes(block.parent_root),
+        state_root=bytes(block.state_root),
+        body_root=type(block.body).hash_tree_root(block.body),
+    )
+
+
+class LightClientServer:
+    def __init__(self, chain):
+        self.chain = chain
+        self.best_update_by_period: Dict[int, "ssz.altair.LightClientUpdate"] = {}
+        self.latest_finality_update: Optional["ssz.altair.LightClientFinalityUpdate"] = None
+        self.latest_optimistic_update: Optional["ssz.altair.LightClientOptimisticUpdate"] = None
+        from .chain import ChainEvent
+
+        chain.on(ChainEvent.block, self._on_block)
+
+    # ------------------------------------------------------------------
+
+    def get_bootstrap(self, block_root: bytes) -> Optional["ssz.altair.LightClientBootstrap"]:
+        """Bootstrap for a (finalized) block root: its header + the state's
+        current sync committee with branch (spec create_light_client_bootstrap)."""
+        signed = self.chain.db.block.get(block_root)
+        state = self.chain.state_cache.get(block_root)
+        if signed is None or state is None:
+            return None
+        st = state.state
+        if not hasattr(st, "current_sync_committee"):
+            return None
+        _, branch, _, _ = container_field_proof(
+            type(st), st, ["current_sync_committee"]
+        )
+        return ssz.altair.LightClientBootstrap(
+            header=block_to_header(signed.message),
+            current_sync_committee=st.current_sync_committee,
+            current_sync_committee_branch=branch,
+        )
+
+    def get_update(self, period: int) -> Optional["ssz.altair.LightClientUpdate"]:
+        return self.best_update_by_period.get(period)
+
+    # ------------------------------------------------------------------
+
+    def _on_block(self, signed_block, root: bytes) -> None:
+        block = signed_block.message
+        agg = getattr(block.body, "sync_aggregate", None)
+        if agg is None:
+            return
+        participation = sum(1 for b in agg.sync_committee_bits if b)
+        if participation < _p.MIN_SYNC_COMMITTEE_PARTICIPANTS:
+            return
+        attested_root = bytes(block.parent_root)
+        attested_state = self.chain.state_cache.get(attested_root)
+        attested_signed = self.chain.db.block.get(attested_root)
+        if attested_state is None or attested_signed is None:
+            return
+        st = attested_state.state
+        if not hasattr(st, "next_sync_committee"):
+            return
+        attested_header = block_to_header(attested_signed.message)
+
+        _, nsc_branch, _, _ = container_field_proof(
+            type(st), st, ["next_sync_committee"]
+        )
+        fin_epoch = st.finalized_checkpoint.epoch
+        fin_root = bytes(st.finalized_checkpoint.root)
+        finalized_header = ssz.phase0.BeaconBlockHeader.default()
+        finality_branch = [b"\x00" * 32] * 6
+        fin_signed = self.chain.db.block.get(fin_root) if fin_root != b"\x00" * 32 else None
+        has_finality = fin_signed is not None
+        if has_finality:
+            finalized_header = block_to_header(fin_signed.message)
+            _, finality_branch, _, _ = container_field_proof(
+                type(st), st, ["finalized_checkpoint", "root"]
+            )
+
+        update = ssz.altair.LightClientUpdate(
+            attested_header=attested_header,
+            next_sync_committee=st.next_sync_committee,
+            next_sync_committee_branch=nsc_branch,
+            finalized_header=finalized_header,
+            finality_branch=finality_branch,
+            sync_aggregate=agg,
+            signature_slot=block.slot,
+        )
+
+        period = sync_period_at_slot(attested_header.slot)
+        best = self.best_update_by_period.get(period)
+        if best is None or self._is_better(update, best):
+            self.best_update_by_period[period] = update
+
+        self.latest_optimistic_update = ssz.altair.LightClientOptimisticUpdate(
+            attested_header=attested_header,
+            sync_aggregate=agg,
+            signature_slot=block.slot,
+        )
+        if has_finality:
+            self.latest_finality_update = ssz.altair.LightClientFinalityUpdate(
+                attested_header=attested_header,
+                finalized_header=finalized_header,
+                finality_branch=finality_branch,
+                sync_aggregate=agg,
+                signature_slot=block.slot,
+            )
+
+    @staticmethod
+    def _is_better(a, b) -> bool:
+        """isBetterUpdate (spec): finality first, then participation."""
+        a_fin = a.finalized_header.slot != 0
+        b_fin = b.finalized_header.slot != 0
+        if a_fin != b_fin:
+            return a_fin
+        pa = sum(1 for x in a.sync_aggregate.sync_committee_bits if x)
+        pb = sum(1 for x in b.sync_aggregate.sync_committee_bits if x)
+        if pa != pb:
+            return pa > pb
+        return a.attested_header.slot > b.attested_header.slot

@@ -162,3 +162,107 @@ class OpPool:
             and epoch >= e.message.epoch
         ][: _p.MAX_VOLUNTARY_EXITS]
         return proposer, attester, exits
+
+
+# ---------------------------------------------------------------------------
+# sync committee pools (altair)
+# ---------------------------------------------------------------------------
+
+
+class SyncCommitteeMessagePool:
+    """Aggregates per-slot/root/subcommittee sync messages into
+    contributions (syncCommitteeMessagePool.ts:126).
+
+    Aggregators pull contributions from here; participants' signatures are
+    naively aggregated exactly like the attestation pool."""
+
+    def __init__(self):
+        # (slot, root, subcommittee) -> (bits, aggregated signature)
+        self._by_key: Dict[Tuple[int, bytes, int], Tuple[List[bool], "bls.Signature"]] = {}
+
+    def add(self, subcommittee_index: int, index_in_subcommittee: int, message) -> None:
+        from lodestar_tpu.types.altair import SYNC_SUBCOMMITTEE_SIZE
+
+        key = (message.slot, bytes(message.beacon_block_root), subcommittee_index)
+        sig = bls.Signature.from_bytes(bytes(message.signature))
+        entry = self._by_key.get(key)
+        if entry is None:
+            bits = [False] * SYNC_SUBCOMMITTEE_SIZE
+            bits[index_in_subcommittee] = True
+            self._by_key[key] = (bits, sig)
+            return
+        bits, agg = entry
+        if bits[index_in_subcommittee]:
+            return  # duplicate participant
+        bits[index_in_subcommittee] = True
+        self._by_key[key] = (bits, bls.aggregate_signatures([agg, sig]))
+
+    def get_contribution(
+        self, slot: int, beacon_block_root: bytes, subcommittee_index: int
+    ) -> Optional["ssz.altair.SyncCommitteeContribution"]:
+        entry = self._by_key.get((slot, bytes(beacon_block_root), subcommittee_index))
+        if entry is None:
+            return None
+        bits, agg = entry
+        return ssz.altair.SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=bytes(beacon_block_root),
+            subcommittee_index=subcommittee_index,
+            aggregation_bits=list(bits),
+            signature=agg.to_bytes(),
+        )
+
+    def prune(self, clock_slot: int) -> None:
+        for k in [k for k in self._by_key if k[0] + SLOTS_RETAINED < clock_slot]:
+            del self._by_key[k]
+
+
+class SyncContributionAndProofPool:
+    """Best contribution per (slot, root, subcommittee) for block packing
+    (syncContributionAndProofPool.ts:169-185): the block's SyncAggregate is
+    assembled by OR-ing the best contributions of the previous slot."""
+
+    def __init__(self):
+        self._best: Dict[Tuple[int, bytes, int], "ssz.altair.SyncCommitteeContribution"] = {}
+
+    def add(self, contribution: "ssz.altair.SyncCommitteeContribution") -> None:
+        key = (
+            contribution.slot,
+            bytes(contribution.beacon_block_root),
+            contribution.subcommittee_index,
+        )
+        best = self._best.get(key)
+        if best is None or sum(contribution.aggregation_bits) > sum(
+            best.aggregation_bits
+        ):
+            self._best[key] = contribution
+
+    def get_sync_aggregate(
+        self, slot: int, beacon_block_root: bytes
+    ) -> "ssz.altair.SyncAggregate":
+        """SyncAggregate for a block at `slot` signing over root at slot-1."""
+        from lodestar_tpu.types.altair import SYNC_SUBCOMMITTEE_SIZE
+        from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_COUNT
+
+        prev_slot = max(1, slot) - 1
+        bits = [False] * _p.SYNC_COMMITTEE_SIZE
+        sigs: List["bls.Signature"] = []
+        for sub in range(SYNC_COMMITTEE_SUBNET_COUNT):
+            c = self._best.get((prev_slot, bytes(beacon_block_root), sub))
+            if c is None:
+                continue
+            for i, b in enumerate(c.aggregation_bits):
+                if b:
+                    bits[sub * SYNC_SUBCOMMITTEE_SIZE + i] = True
+            sigs.append(bls.Signature.from_bytes(bytes(c.signature)))
+        if sigs:
+            sig = bls.aggregate_signatures(sigs).to_bytes()
+        else:
+            sig = b"\xc0" + b"\x00" * 95  # G2 infinity: empty aggregate
+        return ssz.altair.SyncAggregate(
+            sync_committee_bits=bits, sync_committee_signature=sig
+        )
+
+    def prune(self, clock_slot: int) -> None:
+        for k in [k for k in self._best if k[0] + SLOTS_RETAINED < clock_slot]:
+            del self._best[k]

@@ -21,6 +21,11 @@ class StateContextCache:
     def __init__(self, max_states: int = MAX_STATES):
         self._map: "OrderedDict[bytes, CachedBeaconState]" = OrderedDict()
         self.max_states = max_states
+        # Pinned roots are exempt from LRU eviction: the anchor/finalized
+        # state must stay resident or _replay_to has no terminal ancestor
+        # (the anchor block's parent is not in the DB) and deep-branch
+        # regen would fail permanently.
+        self._pinned: set = set()
 
     def get(self, block_root: bytes) -> Optional[CachedBeaconState]:
         st = self._map.get(block_root)
@@ -31,11 +36,26 @@ class StateContextCache:
     def add(self, block_root: bytes, state: CachedBeaconState) -> None:
         self._map[block_root] = state
         self._map.move_to_end(block_root)
-        while len(self._map) > self.max_states:
-            self._map.popitem(last=False)
+        self._evict()
+
+    def pin(self, block_root: bytes) -> None:
+        self._pinned.add(block_root)
+
+    def unpin(self, block_root: bytes) -> None:
+        self._pinned.discard(block_root)
+
+    def _evict(self) -> None:
+        if len(self._map) <= self.max_states:
+            return
+        for root in list(self._map):
+            if len(self._map) <= self.max_states:
+                break
+            if root in self._pinned:
+                continue
+            del self._map[root]
 
     def prune(self, keep_roots) -> None:
-        keep = set(keep_roots)
+        keep = set(keep_roots) | self._pinned
         for root in [r for r in self._map if r not in keep]:
             del self._map[root]
 
@@ -65,24 +85,43 @@ class StateRegenerator:
     """Replay-based state regeneration.  get_block_fn(root) must return the
     stored SignedBeaconBlock for a known root (db.block)."""
 
-    def __init__(self, state_cache: StateContextCache, get_block_fn: Callable):
+    def __init__(
+        self,
+        state_cache: StateContextCache,
+        get_block_fn: Callable,
+        on_miss: Optional[Callable[[], None]] = None,
+    ):
         self.state_cache = state_cache
         self.get_block = get_block_fn
+        self.on_miss = on_miss  # metrics hook: regen cache-miss counter
+        # small memo of dialed-forward pre-states: gossip validation and
+        # the import pipeline request the SAME (parent, slot) back-to-back
+        # and the epoch-boundary dial is expensive (full epoch processing)
+        self._dialed: "OrderedDict[Tuple[bytes, int], CachedBeaconState]" = OrderedDict()
 
     def get_pre_state(self, parent_root: bytes, slot: int) -> CachedBeaconState:
         """State to process a block with `parent_root` at `slot` on top of
-        (regen.getPreState)."""
+        (regen.getPreState).  Callers must treat the result as read-only
+        (state_transition clones before mutating)."""
+        memo = self._dialed.get((parent_root, slot))
+        if memo is not None:
+            return memo
         state = self.state_cache.get(parent_root)
         if state is None:
             state = self._replay_to(parent_root)
         if state.state.slot < slot:
             state = state.clone()
             process_slots(state, slot)
+            self._dialed[(parent_root, slot)] = state
+            while len(self._dialed) > 4:
+                self._dialed.popitem(last=False)
         return state
 
     def _replay_to(self, block_root: bytes) -> CachedBeaconState:
         """Walk back to a cached ancestor, then re-apply blocks forward
         (the regen miss path — hot on deep reorgs, chain/regen/regen.ts)."""
+        if self.on_miss is not None:
+            self.on_miss()
         chain = []
         root = block_root
         state = None
@@ -100,9 +139,6 @@ class StateRegenerator:
                 state, block,
                 verify_state_root=True, verify_proposer=False, verify_signatures=False,
             )
-            from lodestar_tpu.types import ssz
-
-            self.state_cache.add(
-                ssz.phase0.BeaconBlock.hash_tree_root(block.message), state
-            )
+            msg = block.message
+            self.state_cache.add(type(msg).hash_tree_root(msg), state)
         return state

@@ -70,3 +70,20 @@ class SeenAggregatedAttestations:
     def prune(self, finalized_epoch: int) -> None:
         for e in [e for e in self._by_epoch if e <= finalized_epoch]:
             del self._by_epoch[e]
+
+
+class SeenSyncCommitteeMessages:
+    """First-seen dedup for sync committee messages / contributions, keyed
+    (slot, subnet, validator) (seenCommittee.ts / seenCommitteeContribution.ts)."""
+
+    def __init__(self):
+        self._seen: set = set()
+
+    def is_known(self, slot: int, subnet: int, validator_index: int) -> bool:
+        return (slot, subnet, validator_index) in self._seen
+
+    def add(self, slot: int, subnet: int, validator_index: int) -> None:
+        self._seen.add((slot, subnet, validator_index))
+
+    def prune(self, before_slot: int) -> None:
+        self._seen = {k for k in self._seen if k[0] >= before_slot}

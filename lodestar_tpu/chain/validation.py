@@ -55,6 +55,36 @@ class GossipValidationError(Exception):
         self.code = code
 
 
+def get_attestation_verification_state(chain, target, beacon_block_root: bytes) -> object:
+    """State whose shufflings match the attestation's TARGET checkpoint
+    (reference getStateForAttestationVerification): the target checkpoint
+    state, so attestations on a fork with a different shuffling are checked
+    against that fork's committees, not the head's.
+
+    DoS guard: the attacker-controlled target root must be a KNOWN block
+    that is an ancestor of the (already-verified-known) attested head —
+    otherwise an attacker could point target.root at any old resident
+    state and force an unbounded process_slots replay per gossip message
+    (the reference rejects with INVALID_TARGET before touching regen)."""
+    t_root = bytes(target.root)
+    t_hex = "0x" + t_root.hex()
+    if not chain.fork_choice.has_block(t_hex):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "unknown target")
+    head_hex = "0x" + bytes(beacon_block_root).hex()
+    if not chain.fork_choice.is_descendant(t_hex, head_hex):
+        raise GossipValidationError(
+            GossipErrorCode.INVALID_TARGET, "head does not descend from target"
+        )
+    st = chain.get_checkpoint_state(target.epoch, t_root)
+    if st is None:
+        # validating against the head's (possibly different) shuffling
+        # would falsely reject — reject retriably instead
+        raise GossipValidationError(
+            GossipErrorCode.UNKNOWN_BEACON_BLOCK_ROOT, "target state unavailable"
+        )
+    return st
+
+
 def compute_subnet_for_attestation(
     committees_per_slot: int, slot: int, committee_index: int
 ) -> int:
@@ -89,7 +119,9 @@ async def validate_gossip_attestation(
             GossipErrorCode.UNKNOWN_BEACON_BLOCK_ROOT, head_root
         )
 
-    state = chain.get_head_state()
+    state = get_attestation_verification_state(
+        chain, data.target, bytes(data.beacon_block_root)
+    )
     epoch_ctx = state.epoch_ctx
     try:
         committees_per_slot = epoch_ctx.get_committee_count_per_slot(data.target.epoch)
@@ -161,7 +193,9 @@ async def validate_gossip_aggregate_and_proof(
     ):
         raise GossipValidationError(GossipErrorCode.AGGREGATOR_ALREADY_SEEN)
 
-    state = chain.get_head_state()
+    state = get_attestation_verification_state(
+        chain, data.target, bytes(data.beacon_block_root)
+    )
     epoch_ctx = state.epoch_ctx
     committee = epoch_ctx.get_committee(data.slot, data.index)
     bits = list(aggregate.aggregation_bits)
@@ -231,11 +265,13 @@ async def validate_gossip_block(chain, signed_block) -> None:
     if not chain.fork_choice.has_block(parent_root):
         raise GossipValidationError(GossipErrorCode.UNKNOWN_BEACON_BLOCK_ROOT, "parent")
 
-    state = chain.get_head_state()
-    if compute_epoch_at_slot(block.slot) == state.epoch_ctx.epoch:
-        expected = state.epoch_ctx.get_beacon_proposer(block.slot)
-        if block.proposer_index != expected:
-            raise GossipValidationError(GossipErrorCode.BLOCK_SLOT_MISMATCH)
+    # Dial the parent's state forward to the block's slot so the proposer
+    # check ALWAYS runs — the head state's cached epoch lags at the first
+    # slots of a new epoch and gossip must still reject wrong proposers.
+    state = chain.regen.get_pre_state(bytes(block.parent_root), block.slot)
+    expected = state.epoch_ctx.get_beacon_proposer(block.slot)
+    if block.proposer_index != expected:
+        raise GossipValidationError(GossipErrorCode.BLOCK_SLOT_MISMATCH)
 
     from lodestar_tpu.state_transition.signature_sets import (
         get_block_proposer_signature_set,
@@ -246,3 +282,174 @@ async def validate_gossip_block(chain, signed_block) -> None:
     )
     if not await chain.bls.verify_signature_sets([sig_set], VerifyOptions()):
         raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)
+
+
+# ---------------------------------------------------------------------------
+# sync committee gossip (altair; reference chain/validation/syncCommittee.ts
+# and syncCommitteeContributionAndProof.ts)
+# ---------------------------------------------------------------------------
+
+
+def _sync_committee_positions(state, validator_index: int):
+    """All positions of a validator in the current sync committee."""
+    pk = bytes(state.validators[validator_index].pubkey)
+    return [
+        i
+        for i, cpk in enumerate(state.current_sync_committee.pubkeys)
+        if bytes(cpk) == pk
+    ]
+
+
+async def validate_sync_committee_message(
+    chain, message: "ssz.altair.SyncCommitteeMessage", subnet: int
+) -> List[int]:
+    """validateSyncCommitteeSigOnly + structural checks; returns the
+    validator's positions within `subnet`'s subcommittee."""
+    from lodestar_tpu.params import (
+        DOMAIN_SYNC_COMMITTEE,
+        SYNC_COMMITTEE_SUBNET_COUNT,
+        SYNC_COMMITTEE_SUBNET_SIZE,
+    )
+
+    current_slot = chain.clock.current_slot
+    if message.slot not in (current_slot, current_slot - 1):  # 1-slot clock disparity
+        code = (
+            GossipErrorCode.FUTURE_SLOT
+            if message.slot > current_slot
+            else GossipErrorCode.PAST_SLOT
+        )
+        raise GossipValidationError(code, f"sync msg slot {message.slot}")
+
+    state = chain.get_head_state()
+    st = state.state
+    if not hasattr(st, "current_sync_committee"):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "pre-altair")
+    positions = _sync_committee_positions(st, message.validator_index)
+    sub_positions = [
+        p % SYNC_COMMITTEE_SUBNET_SIZE
+        for p in positions
+        if p // SYNC_COMMITTEE_SUBNET_SIZE == subnet
+    ]
+    if not sub_positions:
+        raise GossipValidationError(
+            GossipErrorCode.WRONG_SUBNET, "validator not in subcommittee"
+        )
+    if chain.seen_sync_committee_messages.is_known(
+        message.slot, subnet, message.validator_index
+    ):
+        raise GossipValidationError(GossipErrorCode.ATTESTER_ALREADY_SEEN, "sync msg")
+
+    domain = get_domain(
+        chain.cfg, st, DOMAIN_SYNC_COMMITTEE, compute_epoch_at_slot(message.slot)
+    )
+    root = compute_signing_root(
+        ssz.phase0.Root, bytes(message.beacon_block_root), domain
+    )
+    pk = bls.PublicKey.from_bytes(bytes(st.validators[message.validator_index].pubkey))
+    sig_set = bls.SignatureSet(
+        pk, root, bls.Signature.from_bytes(bytes(message.signature))
+    )
+    if not await chain.bls.verify_signature_sets(
+        [sig_set], VerifyOptions(batchable=True)
+    ):
+        raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)
+    chain.seen_sync_committee_messages.add(message.slot, subnet, message.validator_index)
+    return sub_positions
+
+
+async def validate_sync_committee_contribution(
+    chain, signed: "ssz.altair.SignedContributionAndProof"
+) -> None:
+    """validateSyncCommitteeGossipContributionAndProof: selection proof is
+    an aggregator proof over (slot, subcommittee); three signatures checked
+    as one batchable job like aggregate-and-proof."""
+    from lodestar_tpu.params import (
+        DOMAIN_CONTRIBUTION_AND_PROOF,
+        DOMAIN_SYNC_COMMITTEE,
+        DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        SYNC_COMMITTEE_SUBNET_COUNT,
+        SYNC_COMMITTEE_SUBNET_SIZE,
+    )
+    from lodestar_tpu.state_transition.util.aggregator import (
+        is_sync_committee_aggregator,
+    )
+
+    cp = signed.message
+    contribution = cp.contribution
+    current_slot = chain.clock.current_slot
+    if contribution.slot not in (current_slot, current_slot - 1):
+        code = (
+            GossipErrorCode.FUTURE_SLOT
+            if contribution.slot > current_slot
+            else GossipErrorCode.PAST_SLOT
+        )
+        raise GossipValidationError(code, "contribution slot")
+    if contribution.subcommittee_index >= SYNC_COMMITTEE_SUBNET_COUNT:
+        raise GossipValidationError(GossipErrorCode.COMMITTEE_INDEX_OUT_OF_RANGE)
+    if not any(contribution.aggregation_bits):
+        raise GossipValidationError(GossipErrorCode.NOT_EXACTLY_ONE_BIT, "empty")
+    if not is_sync_committee_aggregator(bytes(cp.selection_proof)):
+        raise GossipValidationError(GossipErrorCode.NOT_AGGREGATOR)
+    if chain.seen_sync_contributions.is_known(
+        contribution.slot, contribution.subcommittee_index, cp.aggregator_index
+    ):
+        raise GossipValidationError(GossipErrorCode.AGGREGATOR_ALREADY_SEEN)
+
+    state = chain.get_head_state()
+    st = state.state
+    if not hasattr(st, "current_sync_committee"):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "pre-altair")
+    positions = _sync_committee_positions(st, cp.aggregator_index)
+    if not any(
+        p // SYNC_COMMITTEE_SUBNET_SIZE == contribution.subcommittee_index
+        for p in positions
+    ):
+        raise GossipValidationError(GossipErrorCode.NOT_AGGREGATOR, "not in subcommittee")
+
+    epoch = compute_epoch_at_slot(contribution.slot)
+    agg_pk = bls.PublicKey.from_bytes(bytes(st.validators[cp.aggregator_index].pubkey))
+    # 1. selection proof over SyncAggregatorSelectionData
+    sel_data = ssz.altair.SyncAggregatorSelectionData(
+        slot=contribution.slot, subcommittee_index=contribution.subcommittee_index
+    )
+    sel_domain = get_domain(
+        chain.cfg, st, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch
+    )
+    sel_set = bls.SignatureSet(
+        agg_pk,
+        compute_signing_root(
+            ssz.altair.SyncAggregatorSelectionData, sel_data, sel_domain
+        ),
+        bls.Signature.from_bytes(bytes(cp.selection_proof)),
+    )
+    # 2. the ContributionAndProof envelope
+    cap_domain = get_domain(chain.cfg, st, DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
+    cap_set = bls.SignatureSet(
+        agg_pk,
+        compute_signing_root(ssz.altair.ContributionAndProof, cp, cap_domain),
+        bls.Signature.from_bytes(bytes(signed.signature)),
+    )
+    # 3. the contribution's aggregate signature by the participants
+    base = contribution.subcommittee_index * SYNC_COMMITTEE_SUBNET_SIZE
+    pks = [
+        bls.PublicKey.from_bytes(bytes(st.current_sync_committee.pubkeys[base + i]))
+        for i, b in enumerate(contribution.aggregation_bits)
+        if b
+    ]
+    msg_domain = get_domain(chain.cfg, st, DOMAIN_SYNC_COMMITTEE, epoch)
+    msg_root = compute_signing_root(
+        ssz.phase0.Root, bytes(contribution.beacon_block_root), msg_domain
+    )
+    contrib_set = bls.SignatureSet(
+        bls.aggregate_public_keys(pks),
+        msg_root,
+        bls.Signature.from_bytes(bytes(contribution.signature)),
+    )
+    ok = await chain.bls.verify_signature_sets(
+        [sel_set, cap_set, contrib_set], VerifyOptions(batchable=True)
+    )
+    if not ok:
+        raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)
+    chain.seen_sync_contributions.add(
+        contribution.slot, contribution.subcommittee_index, cp.aggregator_index
+    )

@@ -43,6 +43,41 @@ def build_parser() -> argparse.ArgumentParser:
         help="tick wall-clock slots (SECONDS_PER_SLOT) instead of running flat out",
     )
     dev.add_argument("--genesis-time", type=int, default=None)
+
+    beacon = sub.add_parser(
+        "beacon",
+        help="run a beacon node: chain + REST API + metrics (cmds/beacon)",
+    )
+    beacon.add_argument("--validators", type=int, default=8,
+                        help="interop validator count for the genesis state")
+    beacon.add_argument("--genesis-time", type=int, default=None)
+    beacon.add_argument("--checkpoint-state", type=str, default=None,
+                        help="weak-subjectivity start: fork-tagged SSZ BeaconState file "
+                             "(initBeaconState.ts checkpoint-sync role)")
+    beacon.add_argument("--rest-port", type=int, default=9596)
+    beacon.add_argument("--metrics-port", type=int, default=8008)
+    beacon.add_argument("--verifier", choices=["oracle", "device"], default="oracle")
+    beacon.add_argument("--slots", type=int, default=None,
+                        help="exit after N clock slots (default: run forever)")
+
+    val = sub.add_parser(
+        "validator",
+        help="run a validator client against a beacon REST endpoint",
+    )
+    val.add_argument("--beacon-url", type=str, default="http://127.0.0.1:9596")
+    val.add_argument("--interop-indices", type=str, default="0..7",
+                     help="interop key range LO..HI (inclusive)")
+    val.add_argument("--slots", type=int, default=None)
+
+    lc = sub.add_parser(
+        "lightclient",
+        help="follow the chain with the altair light client over REST",
+    )
+    lc.add_argument("--beacon-url", type=str, default="http://127.0.0.1:9596")
+    lc.add_argument("--checkpoint-root", type=str, required=False,
+                    help="trusted block root hex (default: the node's finalized root)")
+    lc.add_argument("--updates", type=int, default=4,
+                    help="stop after N processed updates")
     return parser
 
 
@@ -111,6 +146,208 @@ def run_dev(args) -> int:
     return 0
 
 
+def run_beacon(args) -> int:
+    """Beacon node process (cmds/beacon/handler.ts role): chain + REST API
+    + metrics + archiver + light-client server, driven by the wall clock.
+    Block production/attestation comes from `validator` processes over
+    REST."""
+    import asyncio
+
+    from lodestar_tpu.api.server import BeaconRestApiServer
+    from lodestar_tpu.chain.archiver import Archiver
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.chain.light_client_server import LightClientServer
+    from lodestar_tpu.config import default_chain_config as cfg
+    from lodestar_tpu.db import BeaconDb
+    from lodestar_tpu.metrics import Metrics
+    from lodestar_tpu.metrics.server import HttpMetricsServer
+    from lodestar_tpu.state_transition.util.genesis import init_dev_state
+
+    if args.checkpoint_state:
+        # weak-subjectivity start (initBeaconState.ts checkpoint sync)
+        from lodestar_tpu.db.beacon import _STATE_MF
+
+        anchor = _STATE_MF.deserialize(open(args.checkpoint_state, "rb").read())
+        print(f"checkpoint sync: anchor slot {anchor.slot}", flush=True)
+    else:
+        genesis_time = (
+            args.genesis_time if args.genesis_time is not None else int(time.time())
+        )
+        _, anchor = init_dev_state(cfg, args.validators, genesis_time=genesis_time)
+
+    verifier = None
+    if args.verifier == "device":
+        from lodestar_tpu.chain.bls import DeviceBlsVerifier
+
+        verifier = DeviceBlsVerifier()
+
+    metrics = Metrics()
+    chain = BeaconChain(cfg, BeaconDb(), anchor, verifier=verifier, metrics=metrics)
+    Archiver(chain)
+    lc_server = LightClientServer(chain)
+    api = BeaconRestApiServer(
+        chain, chain.db, light_client_server=lc_server
+    )
+
+    async def run():
+        from aiohttp import web
+
+        runner = web.AppRunner(api.app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", args.rest_port)
+        await site.start()
+        msrv = HttpMetricsServer(metrics, port=args.metrics_port)
+        await msrv.start()
+        print(
+            f"beacon node up: REST :{args.rest_port} metrics :{args.metrics_port} "
+            f"genesis_time={chain.genesis_time}",
+            flush=True,
+        )
+        last_slot = -1
+        try:
+            while True:
+                slot = chain.clock.current_slot
+                if slot > last_slot:
+                    last_slot = slot
+                    chain.fork_choice.update_time(max(slot, 0))
+                    metrics.beacon.clock_slot.set(slot)
+                    st = chain.fork_choice.store
+                    print(
+                        json.dumps(
+                            {
+                                "slot": slot,
+                                "head": chain.head_root.hex()[:16],
+                                "justified": st.justified.epoch,
+                                "finalized": st.finalized.epoch,
+                            }
+                        ),
+                        flush=True,
+                    )
+                    if args.slots is not None and slot >= args.slots:
+                        break
+                await asyncio.sleep(0.2)
+        finally:
+            await msrv.close()
+            await runner.cleanup()
+            await chain.close()
+
+    asyncio.run(run())
+    return 0
+
+
+def run_validator(args) -> int:
+    """Validator client process (cmds/validator): duties over REST."""
+    import asyncio
+
+    from lodestar_tpu.api.client import ApiClient
+    from lodestar_tpu.config import ForkConfig, default_chain_config as cfg
+    from lodestar_tpu.state_transition.util.interop import interop_secret_keys
+    from lodestar_tpu.validator.validator import Validator
+    from lodestar_tpu.validator.validator_store import ValidatorStore
+
+    lo, hi = args.interop_indices.split("..")
+    count = int(hi) + 1
+    sks = interop_secret_keys(count)[int(lo) :]
+
+    async def run():
+        api = ApiClient(args.beacon_url)
+        genesis0 = await api.get_genesis()
+        gvr = bytes.fromhex(genesis0["genesis_validators_root"][2:])
+        store = ValidatorStore(sks, ForkConfig(cfg), gvr)
+        v = Validator(api, store)
+        await v.initialize()
+        print(
+            f"validator client: {len(sks)} keys -> {args.beacon_url}", flush=True
+        )
+        genesis_time = int(genesis0["genesis_time"])
+        slot = 0
+        while args.slots is None or slot < args.slots:
+            slot += 1
+            target = genesis_time + slot * cfg.SECONDS_PER_SLOT
+            while time.time() < target:
+                await asyncio.sleep(0.1)
+            await v.run_slot(slot)
+            print(
+                json.dumps(
+                    {
+                        "slot": slot,
+                        "proposed": v.produced_blocks,
+                        "attested": v.produced_attestations,
+                        "aggregated": v.produced_aggregates,
+                    }
+                ),
+                flush=True,
+            )
+
+    asyncio.run(run())
+    return 0
+
+
+def run_lightclient(args) -> int:
+    """Light client follower (cmds/lightclient): bootstrap from a trusted
+    root, then track finality/optimistic updates over REST."""
+    import asyncio
+
+    from lodestar_tpu.api.client import ApiClient
+    from lodestar_tpu.config import default_chain_config as cfg
+    from lodestar_tpu.light_client import LightClient
+    from lodestar_tpu.ssz.json import from_json
+    from lodestar_tpu.types import ssz
+
+    async def run():
+        api = ApiClient(args.beacon_url)
+        genesis = await api.get_genesis()
+        gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+        if args.checkpoint_root:
+            root = bytes.fromhex(args.checkpoint_root.replace("0x", ""))
+        else:
+            cp = await api.get_json(
+                "/eth/v1/beacon/states/head/finality_checkpoints"
+            )
+            root = bytes.fromhex(cp["finalized"]["root"][2:])
+            if root == b"\x00" * 32:
+                hdr = await api.get_json("/eth/v1/beacon/headers/head")
+                root = bytes.fromhex(hdr["root"][2:])
+        bs_json = await api.get_json(
+            f"/eth/v1/beacon/light_client/bootstrap/0x{root.hex()}"
+        )
+        bootstrap = from_json(ssz.altair.LightClientBootstrap, bs_json)
+        lc = LightClient.initialize_from_checkpoint_root(cfg, gvr, root, bootstrap)
+        print(
+            f"light client bootstrapped at slot {lc.store.finalized_header.slot}",
+            flush=True,
+        )
+        processed = 0
+        seen_sigs = set()
+        while processed < args.updates:
+            try:
+                fu_json = await api.get_json(
+                    "/eth/v1/beacon/light_client/finality_update"
+                )
+                fu = from_json(ssz.altair.LightClientFinalityUpdate, fu_json)
+                key = (fu.signature_slot, fu.attested_header.slot)
+                if key not in seen_sigs:
+                    seen_sigs.add(key)
+                    lc.process_finality_update(fu)
+                    processed += 1
+                    print(
+                        json.dumps(
+                            {
+                                "finalized_slot": lc.store.finalized_header.slot,
+                                "optimistic_slot": lc.store.optimistic_header.slot,
+                            }
+                        ),
+                        flush=True,
+                    )
+            except Exception as e:  # not yet available — keep polling
+                if "404" not in str(e):
+                    raise
+            await asyncio.sleep(1.0)
+
+    asyncio.run(run())
+    return 0
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -124,6 +361,12 @@ def main(argv=None) -> int:
         return 0
     if args.command == "dev":
         return run_dev(args)
+    if args.command == "beacon":
+        return run_beacon(args)
+    if args.command == "validator":
+        return run_validator(args)
+    if args.command == "lightclient":
+        return run_lightclient(args)
     parser.print_help()
     return 1
 

@@ -3,11 +3,46 @@ packages/beacon-node/src/db/beacon.ts + repositories/).
 """
 from __future__ import annotations
 
-from lodestar_tpu.types import ssz
+from lodestar_tpu.params import FORK_ORDER, FORK_SEQ, ForkName
+from lodestar_tpu.types import ssz, types_for
 from lodestar_tpu.ssz.core import Bytes32, uint64
 from .controller import KvController, MemoryController
 from .repository import Repository
 from .schema import Bucket
+
+
+class MultiForkType:
+    """Fork-tagged SSZ codec: one leading byte selects the per-fork
+    container (the reference resolves fork types by slot via
+    config.getForkTypes; a tag byte keeps the repo self-describing)."""
+
+    def __init__(self, types_by_fork):
+        self._by_fork = dict(types_by_fork)
+        self._by_tag = {FORK_SEQ[f]: t for f, t in self._by_fork.items()}
+        self._tag_of_type = {t: FORK_SEQ[f] for f, t in self._by_fork.items()}
+
+    def serialize(self, value) -> bytes:
+        t = type(value)
+        tag = self._tag_of_type.get(t)
+        if tag is None:
+            raise TypeError(f"no fork codec for {t!r}")
+        return bytes([tag]) + t.serialize(value)
+
+    def deserialize(self, data: bytes):
+        if not data:
+            raise ValueError("empty multi-fork value")
+        t = self._by_tag.get(data[0])
+        if t is None:
+            raise ValueError(f"unknown fork tag {data[0]}")
+        return t.deserialize(data[1:])
+
+
+_SIGNED_BLOCK_MF = MultiForkType(
+    {f: types_for(f)[2] for f in (ForkName.phase0, ForkName.altair)}
+)
+_STATE_MF = MultiForkType(
+    {f: types_for(f)[0] for f in (ForkName.phase0, ForkName.altair)}
+)
 
 
 class _RootRepo(Repository):
@@ -31,18 +66,18 @@ class BeaconDb:
         self.block = _RootRepo(
             db,
             Bucket.allForks_block,
-            ssz.phase0.SignedBeaconBlock,
-            lambda sb: ssz.phase0.BeaconBlock.hash_tree_root(sb.message),
+            _SIGNED_BLOCK_MF,
+            lambda sb: type(sb.message).hash_tree_root(sb.message),
         )
         # finalized chain by slot
         self.block_archive = Repository(
-            db, Bucket.allForks_blockArchive, ssz.phase0.SignedBeaconBlock
+            db, Bucket.allForks_blockArchive, _SIGNED_BLOCK_MF
         )
         self.block_archive_root_index = Repository(
             db, Bucket.index_blockArchiveRootIndex, uint64, key_length=32
         )
         self.state_archive = Repository(
-            db, Bucket.allForks_stateArchive, ssz.phase0.BeaconState
+            db, Bucket.allForks_stateArchive, _STATE_MF
         )
         self.state_archive_root_index = Repository(
             db, Bucket.index_stateArchiveRootIndex, uint64, key_length=32

@@ -8,7 +8,7 @@ equivocation handling, and head computation.  Time must be advanced with
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from lodestar_tpu.params import ACTIVE_PRESET as _p, INTERVALS_PER_SLOT
 from .proto_array import (
@@ -69,10 +69,18 @@ class ForkChoice:
         store: ForkChoiceStore,
         proto_array: ProtoArray,
         proposer_boost_enabled: bool = True,
+        justified_balances_getter: Optional[
+            Callable[[CheckpointHex], Optional[Sequence[int]]]
+        ] = None,
     ):
         self.cfg = cfg
         self.store = store
         self.proto_array = proto_array
+        # Invoked on EVERY justified-checkpoint change (incl. the on-tick
+        # epoch-boundary pull-up, which has no post-state in hand) so LMD
+        # weights/proposer boost always use the justified state's balances
+        # (reference recomputes via justifiedBalancesGetter on each change).
+        self._justified_balances_getter = justified_balances_getter
         self.votes: List[Optional[VoteTracker]] = []
         self.proposer_boost_root: Optional[str] = None
         self.proposer_boost_enabled = proposer_boost_enabled
@@ -211,7 +219,19 @@ class ForkChoice:
 
     def update_time(self, current_slot: int) -> None:
         """Per-slot tick: reset proposer boost; at epoch boundaries pull
-        unrealized checkpoints into the realized store (spec on_tick)."""
+        unrealized checkpoints into the realized store (spec on_tick).
+
+        Large gaps (cold start against an old anchor) fast-forward in one
+        step: repeated boundary pull-ups with unchanged unrealized
+        checkpoints are idempotent, so crossing N boundaries at once
+        applies the same single update."""
+        if current_slot - self.store.current_slot > _p.SLOTS_PER_EPOCH:
+            boundary = (current_slot // _p.SLOTS_PER_EPOCH) * _p.SLOTS_PER_EPOCH
+            self.store.current_slot = max(self.store.current_slot, boundary)
+            self.proposer_boost_root = None
+            self._update_checkpoints(
+                self.store.unrealized_justified, self.store.unrealized_finalized, None
+            )
         while self.store.current_slot < current_slot:
             self.store.current_slot += 1
             self.proposer_boost_root = None
@@ -236,9 +256,15 @@ class ForkChoice:
     ) -> None:
         if justified.epoch > self.store.justified.epoch:
             self.store.justified = justified
-            if justified_balances is not None:
-                self.store.justified_balances = justified_balances
-                self._justified_proposer_boost_score = None
+            balances = justified_balances
+            if balances is None and self._justified_balances_getter is not None:
+                balances = self._justified_balances_getter(justified)
+            if balances is not None:
+                self.store.justified_balances = balances
+            # even when balances could not be refreshed (getter miss), the
+            # boost score must be recomputed from whatever balances the
+            # store holds so the (balances, score) pair stays consistent
+            self._justified_proposer_boost_score = None
         if finalized.epoch > self.store.finalized.epoch:
             self.store.finalized = finalized
 

@@ -1,0 +1,242 @@
+"""Altair light-client sync protocol (reference:
+packages/light-client/src/ — LightClient index.ts:146,
+spec/processLightClientUpdate.ts, validation.ts; consensus-specs
+altair/light-client/sync-protocol.md).
+
+A LightClient trusts one block root, initializes from a bootstrap
+(current sync committee proven against the trusted header's state root),
+and then follows the chain by validating LightClientUpdates: merkle
+branches for finality/next-sync-committee and the sync committee's BLS
+aggregate signature over the attested header.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DOMAIN_SYNC_COMMITTEE,
+    FINALIZED_ROOT_DEPTH,
+    NEXT_SYNC_COMMITTEE_DEPTH,
+)
+from lodestar_tpu.state_transition.util.domain import (
+    compute_domain,
+    compute_signing_root,
+)
+from lodestar_tpu.state_transition.util.merkle import is_valid_merkle_branch
+from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+from lodestar_tpu.types import ssz
+
+# generalized-index coordinates (validated in tests/test_light_client.py
+# against ssz.proof on a real state)
+FINALIZED_ROOT_INDEX = 41          # depth 6
+NEXT_SYNC_COMMITTEE_INDEX = 23     # depth 5
+CURRENT_SYNC_COMMITTEE_INDEX = 22  # depth 5
+
+
+class LightClientError(ValueError):
+    pass
+
+
+def sync_period(slot: int) -> int:
+    return compute_epoch_at_slot(slot) // _p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+
+
+@dataclass
+class LightClientStore:
+    """Spec LightClientStore."""
+
+    finalized_header: "ssz.phase0.BeaconBlockHeader"
+    current_sync_committee: "ssz.altair.SyncCommittee"
+    next_sync_committee: Optional["ssz.altair.SyncCommittee"] = None
+    optimistic_header: Optional["ssz.phase0.BeaconBlockHeader"] = None
+    previous_max_active_participants: int = 0
+    current_max_active_participants: int = 0
+
+
+class LightClient:
+    def __init__(self, cfg, genesis_validators_root: bytes, store: LightClientStore):
+        self.cfg = cfg
+        self.genesis_validators_root = genesis_validators_root
+        self.store = store
+
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def initialize_from_checkpoint_root(
+        cls, cfg, genesis_validators_root: bytes, trusted_block_root: bytes, bootstrap
+    ) -> "LightClient":
+        """Spec initialize_light_client_store: verify the bootstrap header
+        matches the trusted root and the committee branch proves into its
+        state root (LightClient.initializeFromCheckpointRoot)."""
+        header_root = ssz.phase0.BeaconBlockHeader.hash_tree_root(bootstrap.header)
+        if header_root != trusted_block_root:
+            raise LightClientError("bootstrap header != trusted checkpoint root")
+        leaf = ssz.altair.SyncCommittee.hash_tree_root(bootstrap.current_sync_committee)
+        if not is_valid_merkle_branch(
+            leaf,
+            [bytes(b) for b in bootstrap.current_sync_committee_branch],
+            NEXT_SYNC_COMMITTEE_DEPTH,
+            CURRENT_SYNC_COMMITTEE_INDEX,
+            bytes(bootstrap.header.state_root),
+        ):
+            raise LightClientError("invalid current sync committee branch")
+        store = LightClientStore(
+            finalized_header=bootstrap.header,
+            current_sync_committee=bootstrap.current_sync_committee,
+            optimistic_header=bootstrap.header,
+        )
+        return cls(cfg, genesis_validators_root, store)
+
+    # ------------------------------------------------------------------
+
+    def _fork_version_at(self, epoch: int) -> bytes:
+        cfg = self.cfg
+        if epoch >= cfg.ALTAIR_FORK_EPOCH:
+            return cfg.ALTAIR_FORK_VERSION
+        return cfg.GENESIS_FORK_VERSION
+
+    def validate_update(self, update) -> None:
+        """Spec validate_light_client_update."""
+        store = self.store
+        agg = update.sync_aggregate
+        participation = sum(1 for b in agg.sync_committee_bits if b)
+        if participation < _p.MIN_SYNC_COMMITTEE_PARTICIPANTS:
+            raise LightClientError("insufficient sync participation")
+        if not (
+            update.signature_slot > update.attested_header.slot
+            and update.attested_header.slot >= update.finalized_header.slot
+        ):
+            raise LightClientError("update slots out of order")
+
+        store_period = sync_period(store.finalized_header.slot)
+        sig_period = sync_period(update.signature_slot)
+        if store.next_sync_committee is not None:
+            if sig_period not in (store_period, store_period + 1):
+                raise LightClientError("signature period out of range")
+        elif sig_period != store_period:
+            raise LightClientError("signature period != store period")
+
+        # finality proof
+        if update.finalized_header.slot != 0:
+            leaf = ssz.phase0.BeaconBlockHeader.hash_tree_root(update.finalized_header)
+            if not is_valid_merkle_branch(
+                leaf,
+                [bytes(b) for b in update.finality_branch],
+                FINALIZED_ROOT_DEPTH,
+                FINALIZED_ROOT_INDEX,
+                bytes(update.attested_header.state_root),
+            ):
+                raise LightClientError("invalid finality branch")
+
+        # next sync committee proof (against the ATTESTED state)
+        if any(bytes(pk) != b"\x00" * 48 for pk in update.next_sync_committee.pubkeys):
+            leaf = ssz.altair.SyncCommittee.hash_tree_root(update.next_sync_committee)
+            if not is_valid_merkle_branch(
+                leaf,
+                [bytes(b) for b in update.next_sync_committee_branch],
+                NEXT_SYNC_COMMITTEE_DEPTH,
+                NEXT_SYNC_COMMITTEE_INDEX,
+                bytes(update.attested_header.state_root),
+            ):
+                raise LightClientError("invalid next sync committee branch")
+
+        # sync committee BLS signature over the attested header
+        if sig_period == sync_period(store.finalized_header.slot):
+            committee = store.current_sync_committee
+        else:
+            committee = store.next_sync_committee
+            if committee is None:
+                raise LightClientError("no next sync committee known")
+        pks = [
+            bls.PublicKey.from_bytes(bytes(pk))
+            for pk, bit in zip(committee.pubkeys, agg.sync_committee_bits)
+            if bit
+        ]
+        signing_epoch = compute_epoch_at_slot(max(1, update.signature_slot) - 1)
+        domain = compute_domain(
+            DOMAIN_SYNC_COMMITTEE,
+            self._fork_version_at(signing_epoch),
+            self.genesis_validators_root,
+        )
+        root = compute_signing_root(
+            ssz.phase0.Root,
+            ssz.phase0.BeaconBlockHeader.hash_tree_root(update.attested_header),
+            domain,
+        )
+        try:
+            sig = bls.Signature.from_bytes(bytes(agg.sync_committee_signature))
+            ok = bls.fast_aggregate_verify(pks, root, sig)
+        except ValueError as e:  # BlsError or point-decoding ValueError
+            raise LightClientError(f"malformed sync committee signature: {e}")
+        if not ok:
+            raise LightClientError("invalid sync committee signature")
+
+    def process_update(self, update) -> None:
+        """Spec process_light_client_update (apply-if-valid, advance
+        finalized/optimistic headers and committee period)."""
+        self.validate_update(update)
+        store = self.store
+        participation = sum(1 for b in update.sync_aggregate.sync_committee_bits if b)
+        store.current_max_active_participants = max(
+            store.current_max_active_participants, participation
+        )
+        if (
+            update.attested_header.slot
+            > (store.optimistic_header.slot if store.optimistic_header else 0)
+        ):
+            store.optimistic_header = update.attested_header
+
+        store_period = sync_period(store.finalized_header.slot)
+        update_period = sync_period(update.attested_header.slot)
+        has_nsc = any(
+            bytes(pk) != b"\x00" * 48 for pk in update.next_sync_committee.pubkeys
+        )
+        if has_nsc and update_period == store_period:
+            if store.next_sync_committee is None:
+                store.next_sync_committee = update.next_sync_committee
+
+        if (
+            update.finalized_header.slot != 0
+            and participation * 3 >= len(update.sync_aggregate.sync_committee_bits) * 2
+            and update.finalized_header.slot > store.finalized_header.slot
+        ):
+            fin_period = sync_period(update.finalized_header.slot)
+            if fin_period == store_period + 1 and store.next_sync_committee is not None:
+                store.current_sync_committee = store.next_sync_committee
+                store.next_sync_committee = (
+                    update.next_sync_committee if has_nsc else None
+                )
+                store.previous_max_active_participants = (
+                    store.current_max_active_participants
+                )
+                store.current_max_active_participants = 0
+            store.finalized_header = update.finalized_header
+
+    def process_finality_update(self, fu) -> None:
+        """Accept a LightClientFinalityUpdate by lifting it into a full
+        update with an empty next-sync-committee section."""
+        update = ssz.altair.LightClientUpdate(
+            attested_header=fu.attested_header,
+            finalized_header=fu.finalized_header,
+            finality_branch=list(fu.finality_branch),
+            sync_aggregate=fu.sync_aggregate,
+            signature_slot=fu.signature_slot,
+        )
+        self.process_update(update)
+
+    def process_optimistic_update(self, ou) -> None:
+        update = ssz.altair.LightClientUpdate(
+            attested_header=ou.attested_header,
+            sync_aggregate=ou.sync_aggregate,
+            signature_slot=ou.signature_slot,
+        )
+        # no finality/committee sections: only the signature + slot checks
+        self.validate_update(update)
+        if (
+            ou.attested_header.slot
+            > (self.store.optimistic_header.slot if self.store.optimistic_header else 0)
+        ):
+            self.store.optimistic_header = ou.attested_header

@@ -27,6 +27,7 @@ from .reqresp.protocols import (
     STATUS,
 )
 from .reqresp.reqresp import ReqRespNode
+from lodestar_tpu.types import signed_block_wire_codec
 from .transport import Endpoint, InProcessHub
 
 
@@ -34,6 +35,7 @@ class Network:
     def __init__(self, hub: InProcessHub, chain, db, peer_id: Optional[str] = None):
         self.chain = chain
         self.db = db
+        signed_block_wire_codec.configure(chain.cfg)
         self.endpoint = Endpoint(hub, peer_id)
         self.peer_id = self.endpoint.peer_id
         fork_digest = compute_fork_digest(
@@ -179,7 +181,7 @@ class Network:
             )
 
         self.gossip.subscribe(
-            GossipType.beacon_block, ssz.phase0.SignedBeaconBlock, on_block
+            GossipType.beacon_block, signed_block_wire_codec, on_block
         )
         self.gossip.subscribe(
             GossipType.beacon_aggregate_and_proof,
@@ -219,9 +221,75 @@ class Network:
         self.metadata.attnets[subnet] = True
         self.metadata.seq_number += 1
 
+
+    def subscribe_sync_committee_subnet(self, subnet: int) -> None:
+        """sync_committee_{subnet} topic: validate + feed the message pool
+        (syncnetsService.ts role)."""
+        from lodestar_tpu.chain.validation import (
+            GossipValidationError,
+            validate_sync_committee_message,
+        )
+
+        async def on_sync_message(from_peer, message):
+            try:
+                positions = await validate_sync_committee_message(
+                    self.chain, message, subnet
+                )
+            except GossipValidationError:
+                self.peer_manager.scores.apply_action(
+                    from_peer, PeerAction.HighToleranceError
+                )
+                raise
+            for pos in positions:
+                self.chain.sync_committee_message_pool.add(subnet, pos, message)
+
+        self.gossip.subscribe(
+            GossipType.sync_committee,
+            ssz.altair.SyncCommitteeMessage,
+            on_sync_message,
+            subnet=subnet,
+        )
+
+    def subscribe_sync_contributions(self) -> None:
+        from lodestar_tpu.chain.validation import (
+            GossipValidationError,
+            validate_sync_committee_contribution,
+        )
+
+        async def on_contribution(from_peer, signed):
+            try:
+                await validate_sync_committee_contribution(self.chain, signed)
+            except GossipValidationError:
+                self.peer_manager.scores.apply_action(
+                    from_peer, PeerAction.LowToleranceError
+                )
+                raise
+            self.chain.sync_contribution_pool.add(signed.message.contribution)
+
+        self.gossip.subscribe(
+            GossipType.sync_committee_contribution_and_proof,
+            ssz.altair.SignedContributionAndProof,
+            on_contribution,
+        )
+
+    async def publish_sync_committee_message(self, message, subnet: int) -> int:
+        return await self.gossip.publish(
+            GossipType.sync_committee,
+            ssz.altair.SyncCommitteeMessage,
+            message,
+            subnet,
+        )
+
+    async def publish_sync_contribution(self, signed) -> int:
+        return await self.gossip.publish(
+            GossipType.sync_committee_contribution_and_proof,
+            ssz.altair.SignedContributionAndProof,
+            signed,
+        )
+
     async def publish_block(self, signed_block) -> int:
         return await self.gossip.publish(
-            GossipType.beacon_block, ssz.phase0.SignedBeaconBlock, signed_block
+            GossipType.beacon_block, signed_block_wire_codec, signed_block
         )
 
     async def publish_attestation(self, attestation, subnet: int) -> int:

@@ -43,13 +43,17 @@ STATUS = Protocol("status", 1, ssz.phase0.Status, ssz.phase0.Status)
 GOODBYE = Protocol("goodbye", 1, uint64, uint64)
 PING = Protocol("ping", 1, uint64, uint64)
 METADATA = Protocol("metadata", 2, None, ssz.phase0.Metadata)
+# fork-aware block codec: resolves phase0/altair from the slot inside the
+# serialized block (configured by Network from the chain config)
+from lodestar_tpu.types import signed_block_wire_codec
+
 BEACON_BLOCKS_BY_RANGE = Protocol(
     "beacon_blocks_by_range", 1, BeaconBlocksByRangeRequest,
-    ssz.phase0.SignedBeaconBlock, max_response_chunks=1024,
+    signed_block_wire_codec, max_response_chunks=1024,
 )
 BEACON_BLOCKS_BY_ROOT = Protocol(
     "beacon_blocks_by_root", 1, BeaconBlocksByRootRequest,
-    ssz.phase0.SignedBeaconBlock, max_response_chunks=1024,
+    signed_block_wire_codec, max_response_chunks=1024,
 )
 
 ALL_PROTOCOLS = [

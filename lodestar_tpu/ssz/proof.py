@@ -1,0 +1,76 @@
+"""SSZ merkle proofs over container field paths (reference:
+@chainsafe/persistent-merkle-tree getSingleProof +
+beacon-node/src/chain/lightClient/proofs.ts).
+
+The light-client protocol needs branches for state fields
+(current/next_sync_committee, finalized_checkpoint.root) against the
+state root.  Proofs compose bottom-up along a field path: the generalized
+index is the concatenation of each level's (depth, index) pair and the
+branch is inner-first sibling hashes — exactly what
+is_valid_merkle_branch consumes.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from lodestar_tpu.state_transition.util.merkle import list_tree_layers
+from .core import ContainerMeta, ZERO_HASHES, merkleize_chunks
+
+
+def _container_depth(n_fields: int) -> int:
+    limit = 1 if n_fields <= 1 else 1 << (n_fields - 1).bit_length()
+    return limit.bit_length() - 1
+
+
+def _single_level_proof(
+    cls: ContainerMeta, value, field: str
+) -> Tuple[bytes, List[bytes], int, int]:
+    """(leaf, branch, depth, index) for one container field."""
+    names = list(cls._fields_.keys())
+    index = names.index(field)
+    leaves = cls.field_roots(value)
+    depth = _container_depth(len(leaves))
+    layers = list_tree_layers(leaves, depth)
+    branch = []
+    idx = index
+    for level in range(depth):
+        sib = idx ^ 1
+        layer = layers[level]
+        branch.append(layer[sib] if sib < len(layer) else ZERO_HASHES[level])
+        idx >>= 1
+    return leaves[index], branch, depth, index
+
+
+def container_field_proof(
+    cls: ContainerMeta, value, path: Sequence[str]
+) -> Tuple[bytes, List[bytes], int, int]:
+    """Proof of the subtree at `path` (outermost field first) against
+    ``cls.hash_tree_root(value)``.
+
+    Returns (leaf, branch, depth, index) where branch is bottom-up —
+    verify with is_valid_merkle_branch(leaf, branch, depth, index, root).
+    """
+    if not path:
+        raise ValueError("empty path")
+    # walk down to the innermost container, collecting per-level proofs
+    levels = []  # (leaf, branch, depth, index) outermost-first
+    cur_cls, cur_val = cls, value
+    for field in path:
+        leaf, branch, depth, index = _single_level_proof(cur_cls, cur_val, field)
+        levels.append((leaf, branch, depth, index))
+        t = cur_cls._fields_[field]
+        if isinstance(t, ContainerMeta):
+            cur_cls, cur_val = t, getattr(cur_val, field)
+        else:
+            cur_cls, cur_val = None, getattr(cur_val, field)
+
+    # compose bottom-up: innermost branch first
+    leaf = levels[-1][0]
+    branch: List[bytes] = []
+    depth = 0
+    index = 0
+    for lvl_leaf, lvl_branch, lvl_depth, lvl_index in reversed(levels):
+        branch.extend(lvl_branch)
+        index |= lvl_index << depth
+        depth += lvl_depth
+    return leaf, branch, depth, index

@@ -1,0 +1,274 @@
+"""Altair block processing (reference:
+packages/state-transition/src/block/{processAttestationsAltair,
+processSyncCommittee}.ts; consensus-specs altair/beacon-chain.md).
+
+Attestations set per-validator participation FLAG BITS (replacing phase0's
+PendingAttestation lists) and pay the proposer immediately; the sync
+aggregate is verified against the previous slot's block root and pays
+participants + proposer.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import math
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DOMAIN_SYNC_COMMITTEE,
+    FORK_SEQ,
+    ForkName,
+    PARTICIPATION_FLAG_WEIGHTS,
+    PROPOSER_WEIGHT,
+    SYNC_REWARD_WEIGHT,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+)
+from lodestar_tpu.types import ssz
+from ..epoch_context import EpochContext
+from ..util.domain import compute_signing_root
+from ..util.misc import (
+    compute_epoch_at_slot,
+    get_block_root,
+    get_block_root_at_slot,
+)
+from . import phase0 as b0
+from .process_deposit import process_deposit
+
+
+def get_base_reward_per_increment(total_active_balance: int) -> int:
+    return (
+        _p.EFFECTIVE_BALANCE_INCREMENT
+        * _p.BASE_REWARD_FACTOR
+        // math.isqrt(total_active_balance)
+    )
+
+
+def get_base_reward(state, epoch_ctx: EpochContext, index: int,
+                    base_reward_per_increment: Optional[int] = None) -> int:
+    if base_reward_per_increment is None:
+        base_reward_per_increment = get_base_reward_per_increment(
+            epoch_ctx.total_active_balance_increments() * _p.EFFECTIVE_BALANCE_INCREMENT
+        )
+    increments = state.validators[index].effective_balance // _p.EFFECTIVE_BALANCE_INCREMENT
+    return increments * base_reward_per_increment
+
+
+def get_attestation_participation_flag_indices(
+    cfg, state, data, inclusion_delay: int
+) -> List[int]:
+    """Spec get_attestation_participation_flag_indices."""
+    epoch = compute_epoch_at_slot(state.slot)
+    if data.target.epoch == epoch:
+        justified = state.current_justified_checkpoint
+    else:
+        justified = state.previous_justified_checkpoint
+    if data.source != justified:
+        raise ValueError("attestation source != justified checkpoint")
+    is_matching_source = True
+    try:
+        is_matching_target = bytes(data.target.root) == get_block_root(
+            state, data.target.epoch
+        )
+    except ValueError:
+        is_matching_target = False
+    is_matching_head = False
+    if is_matching_target:
+        try:
+            is_matching_head = bytes(data.beacon_block_root) == get_block_root_at_slot(
+                state, data.slot
+            )
+        except ValueError:
+            is_matching_head = False
+
+    flags: List[int] = []
+    if is_matching_source and inclusion_delay <= int(
+        math.isqrt(_p.SLOTS_PER_EPOCH)
+    ):
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= _p.SLOTS_PER_EPOCH:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == _p.MIN_ATTESTATION_INCLUSION_DELAY:
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+def process_attestation(
+    cfg, state, epoch_ctx: EpochContext, attestation, verify_signature: bool = True
+) -> None:
+    """Altair processAttestation: same structural checks as phase0, then
+    flag updates + proposer reward instead of PendingAttestation append."""
+    data = attestation.data
+    epoch = compute_epoch_at_slot(state.slot)
+    previous_epoch = max(0, epoch - 1)
+    if data.target.epoch not in (previous_epoch, epoch):
+        raise ValueError("attestation target epoch out of range")
+    if data.target.epoch != compute_epoch_at_slot(data.slot):
+        raise ValueError("attestation target/slot mismatch")
+    if not (
+        data.slot + _p.MIN_ATTESTATION_INCLUSION_DELAY
+        <= state.slot
+        <= data.slot + _p.SLOTS_PER_EPOCH
+    ):
+        raise ValueError("attestation inclusion window")
+    if data.index >= epoch_ctx.get_committee_count_per_slot(data.target.epoch):
+        raise ValueError("attestation committee index out of range")
+
+    inclusion_delay = state.slot - data.slot
+    flag_indices = get_attestation_participation_flag_indices(
+        cfg, state, data, inclusion_delay
+    )
+
+    indexed = b0.get_indexed_attestation(epoch_ctx, attestation)
+    if not b0.is_valid_indexed_attestation(cfg, state, indexed, verify_signature):
+        raise ValueError("invalid attestation (indices/signature)")
+
+    participation = (
+        state.current_epoch_participation
+        if data.target.epoch == epoch
+        else state.previous_epoch_participation
+    )
+    base_reward_per_increment = get_base_reward_per_increment(
+        epoch_ctx.total_active_balance_increments() * _p.EFFECTIVE_BALANCE_INCREMENT
+    )
+    proposer_reward_numerator = 0
+    for index in indexed.attesting_indices:
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if flag_index in flag_indices and not (
+                participation[index] & (1 << flag_index)
+            ):
+                participation[index] |= 1 << flag_index
+                proposer_reward_numerator += (
+                    get_base_reward(state, epoch_ctx, index, base_reward_per_increment)
+                    * weight
+                )
+    proposer_reward_denominator = (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT
+    )
+    proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+    proposer = epoch_ctx.get_beacon_proposer(state.slot)
+    state.balances[proposer] += proposer_reward
+
+
+# ---------------------------------------------------------------------------
+# sync aggregate
+# ---------------------------------------------------------------------------
+
+
+def get_sync_committee_indices(state, epoch_ctx: EpochContext) -> List[int]:
+    """Validator indices of state.current_sync_committee (cached on the
+    epoch context; the reference keeps this in EpochContext
+    currentSyncCommitteeIndexed)."""
+    cache = getattr(epoch_ctx, "_sync_committee_indices", None)
+    key = bytes(state.current_sync_committee.aggregate_pubkey)
+    if cache is not None and cache[0] == key:
+        return cache[1]
+    indices = [
+        epoch_ctx.pubkey2index[bytes(pk)]
+        for pk in state.current_sync_committee.pubkeys
+    ]
+    epoch_ctx._sync_committee_indices = (key, indices)
+    return indices
+
+
+def get_sync_aggregate_signature_set(cfg, state, epoch_ctx, block):
+    """The sync aggregate's BLS set: participants sign the PREVIOUS slot's
+    block root (signatureSets/syncCommittee role)."""
+    agg = block.body.sync_aggregate
+    previous_slot = max(1, block.slot) - 1
+    root = get_block_root_at_slot(state, previous_slot)
+    domain = b0.get_domain(
+        cfg, state, DOMAIN_SYNC_COMMITTEE, compute_epoch_at_slot(previous_slot)
+    )
+    signing_root = compute_signing_root(ssz.phase0.Root, root, domain)
+    pks = [
+        bls.PublicKey.from_bytes(bytes(pk))
+        for pk, bit in zip(state.current_sync_committee.pubkeys, agg.sync_committee_bits)
+        if bit
+    ]
+    if not pks:
+        return None
+    return bls.SignatureSet(
+        bls.aggregate_public_keys(pks),
+        signing_root,
+        bls.Signature.from_bytes(bytes(agg.sync_committee_signature)),
+    )
+
+
+def process_sync_aggregate(
+    cfg, state, epoch_ctx: EpochContext, block, verify_signature: bool = True
+) -> None:
+    agg = block.body.sync_aggregate
+    if verify_signature:
+        sig_set = get_sync_aggregate_signature_set(cfg, state, epoch_ctx, block)
+        if sig_set is not None and not bls.verify_signature_set(sig_set):
+            raise ValueError("invalid sync aggregate signature")
+        if sig_set is None and bls.Signature.from_bytes(
+            bytes(agg.sync_committee_signature)
+        ).point is not None:
+            raise ValueError("empty sync aggregate must carry infinity signature")
+
+    # participant + proposer rewards (spec process_sync_aggregate)
+    total_active_increments = epoch_ctx.total_active_balance_increments()
+    total_base_rewards = get_base_reward_per_increment(
+        total_active_increments * _p.EFFECTIVE_BALANCE_INCREMENT
+    ) * total_active_increments
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT // WEIGHT_DENOMINATOR // _p.SLOTS_PER_EPOCH
+    )
+    participant_reward = max_participant_rewards // _p.SYNC_COMMITTEE_SIZE
+    proposer_reward = (
+        participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+    proposer = epoch_ctx.get_beacon_proposer(state.slot)
+    committee_indices = get_sync_committee_indices(state, epoch_ctx)
+    for i, bit in enumerate(agg.sync_committee_bits):
+        participant = committee_indices[i]
+        if bit:
+            state.balances[participant] += participant_reward
+            state.balances[proposer] += proposer_reward
+        else:
+            state.balances[participant] = max(
+                0, state.balances[participant] - participant_reward
+            )
+
+
+# ---------------------------------------------------------------------------
+# the block body
+# ---------------------------------------------------------------------------
+
+
+def process_operations(
+    cfg, state, epoch_ctx: EpochContext, body, verify_signatures: bool = True
+) -> None:
+    expected_deposits = min(
+        _p.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    if len(body.deposits) != expected_deposits:
+        raise ValueError(
+            f"expected {expected_deposits} deposits, block has {len(body.deposits)}"
+        )
+    for ps in body.proposer_slashings:
+        b0.process_proposer_slashing(cfg, state, epoch_ctx, ps, verify_signatures)
+    for asl in body.attester_slashings:
+        b0.process_attester_slashing(cfg, state, epoch_ctx, asl, verify_signatures)
+    for att in body.attestations:
+        process_attestation(cfg, state, epoch_ctx, att, verify_signatures)
+    for dep in body.deposits:
+        process_deposit(ForkName.altair, cfg, state, dep, epoch_ctx.pubkey2index)
+    for ex in body.voluntary_exits:
+        b0.process_voluntary_exit(cfg, state, epoch_ctx, ex, verify_signatures)
+
+
+def process_block(
+    cfg, state, epoch_ctx: EpochContext, block, verify_signatures: bool = True
+) -> None:
+    b0.process_block_header(cfg, state, epoch_ctx, block)
+    b0.process_randao(cfg, state, epoch_ctx, block.body, verify_signatures)
+    b0.process_eth1_data(cfg, state, block.body)
+    process_operations(cfg, state, epoch_ctx, block.body, verify_signatures)
+    process_sync_aggregate(cfg, state, epoch_ctx, block, verify_signatures)

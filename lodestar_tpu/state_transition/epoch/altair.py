@@ -1,0 +1,257 @@
+"""Altair epoch processing (reference:
+packages/state-transition/src/epoch/ altair branches; consensus-specs
+altair/beacon-chain.md epoch processing).
+
+Same flat-array strategy as phase0: the per-validator participation FLAG
+bytes already live in the state as uint8 lists, so before_process_epoch
+just views them as numpy arrays — the altair state layout is exactly the
+vectorized representation phase0 had to reconstruct from attestations
+(SURVEY §2.4 note).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    GENESIS_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+)
+from lodestar_tpu.types import ssz
+from ..epoch_context import EpochContext
+from ..util.misc import compute_epoch_at_slot
+from ..util.sync_committee import get_next_sync_committee
+from . import phase0 as e0
+
+
+@dataclass
+class AltairEpochProcess:
+    current_epoch: int
+    previous_epoch: int
+    total_active_balance: int
+    prev_participation: np.ndarray   # uint8 flag bytes
+    curr_participation: np.ndarray
+    effective_balances: np.ndarray   # int64 gwei
+    unslashed: np.ndarray            # bool
+    is_active_prev: np.ndarray
+    is_active_curr: np.ndarray
+    eligible: np.ndarray
+    balances: Optional[np.ndarray] = None
+
+
+def before_process_epoch(cfg, state, epoch_ctx: EpochContext) -> AltairEpochProcess:
+    current_epoch = compute_epoch_at_slot(state.slot)
+    previous_epoch = max(GENESIS_EPOCH, current_epoch - 1)
+
+    eff = np.array([v.effective_balance for v in state.validators], dtype=np.int64)
+    slashed = np.array([v.slashed for v in state.validators], dtype=bool)
+    activation = np.array(
+        [v.activation_epoch for v in state.validators], dtype=np.float64
+    )
+    exit_e = np.array([v.exit_epoch for v in state.validators], dtype=np.float64)
+    withdrawable = np.array(
+        [v.withdrawable_epoch for v in state.validators], dtype=np.float64
+    )
+    is_active_prev = (activation <= previous_epoch) & (previous_epoch < exit_e)
+    is_active_curr = (activation <= current_epoch) & (current_epoch < exit_e)
+    eligible = is_active_prev | (slashed & (previous_epoch + 1 < withdrawable))
+
+    prev_part = np.array(state.previous_epoch_participation, dtype=np.uint8)
+    curr_part = np.array(state.current_epoch_participation, dtype=np.uint8)
+
+    total_active = int(eff[is_active_curr].sum())
+    return AltairEpochProcess(
+        current_epoch=current_epoch,
+        previous_epoch=previous_epoch,
+        total_active_balance=max(_p.EFFECTIVE_BALANCE_INCREMENT, total_active),
+        prev_participation=prev_part,
+        curr_participation=curr_part,
+        effective_balances=eff,
+        unslashed=~slashed,
+        is_active_prev=is_active_prev,
+        is_active_curr=is_active_curr,
+        eligible=eligible,
+    )
+
+
+def _unslashed_participating_balance(
+    proc: AltairEpochProcess, flag_index: int, previous: bool
+) -> int:
+    part = proc.prev_participation if previous else proc.curr_participation
+    active = proc.is_active_prev if previous else proc.is_active_curr
+    m = active & proc.unslashed & ((part & (1 << flag_index)) != 0)
+    return max(_p.EFFECTIVE_BALANCE_INCREMENT, int(proc.effective_balances[m].sum()))
+
+
+def process_justification_and_finalization(cfg, state, proc) -> None:
+    if proc.current_epoch <= GENESIS_EPOCH + 1:
+        return
+    prev_target = _unslashed_participating_balance(
+        proc, TIMELY_TARGET_FLAG_INDEX, previous=True
+    )
+    curr_target = _unslashed_participating_balance(
+        proc, TIMELY_TARGET_FLAG_INDEX, previous=False
+    )
+    e0.weigh_justification_and_finalization(
+        cfg, state, proc.total_active_balance, prev_target, curr_target
+    )
+
+
+# ---------------------------------------------------------------------------
+# inactivity + rewards
+# ---------------------------------------------------------------------------
+
+
+def _finality_delay(proc, state) -> int:
+    return proc.previous_epoch - state.finalized_checkpoint.epoch
+
+
+def is_in_inactivity_leak(proc, state) -> bool:
+    return _finality_delay(proc, state) > _p.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+
+def process_inactivity_updates(cfg, state, proc: AltairEpochProcess) -> None:
+    if proc.current_epoch == GENESIS_EPOCH:
+        return
+    scores = np.array(state.inactivity_scores, dtype=np.int64)
+    prev_target = (
+        proc.unslashed
+        & proc.is_active_prev
+        & ((proc.prev_participation & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0)
+    )
+    leaking = is_in_inactivity_leak(proc, state)
+    # eligible validators only
+    el = proc.eligible
+    inc = el & ~prev_target
+    scores[el & prev_target] = np.maximum(0, scores[el & prev_target] - 1)
+    scores[inc] += cfg.INACTIVITY_SCORE_BIAS
+    if not leaking:
+        scores[el] = np.maximum(
+            0, scores[el] - cfg.INACTIVITY_SCORE_RECOVERY_RATE
+        )
+    for i in np.nonzero(el)[0]:
+        state.inactivity_scores[int(i)] = int(scores[i])
+
+
+def get_flag_deltas(cfg, state, proc: AltairEpochProcess):
+    """Vectorized altair get_flag_index_deltas + inactivity penalties."""
+    n = len(proc.effective_balances)
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+
+    import math
+
+    increment = _p.EFFECTIVE_BALANCE_INCREMENT
+    base_reward_per_increment = (
+        increment * _p.BASE_REWARD_FACTOR // math.isqrt(proc.total_active_balance)
+    )
+    base_rewards = (proc.effective_balances // increment) * base_reward_per_increment
+    total_incr = proc.total_active_balance // increment
+    leaking = is_in_inactivity_leak(proc, state)
+
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        participating = (
+            proc.unslashed
+            & proc.is_active_prev
+            & ((proc.prev_participation & (1 << flag_index)) != 0)
+        )
+        unslashed_incr = (
+            max(increment, int(proc.effective_balances[participating].sum()))
+            // increment
+        )
+        mask_r = proc.eligible & participating
+        mask_p = proc.eligible & ~participating
+        if not leaking:
+            reward_numerator = (
+                base_rewards[mask_r] * weight * unslashed_incr
+            )
+            rewards[mask_r] += reward_numerator // (total_incr * WEIGHT_DENOMINATOR)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties[mask_p] += base_rewards[mask_p] * weight // WEIGHT_DENOMINATOR
+
+    # inactivity penalties (spec get_inactivity_penalty_deltas)
+    scores = np.array(state.inactivity_scores, dtype=np.int64)
+    prev_target = (
+        proc.unslashed
+        & proc.is_active_prev
+        & ((proc.prev_participation & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0)
+    )
+    mask = proc.eligible & ~prev_target
+    penalty_den = cfg.INACTIVITY_SCORE_BIAS * _p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    penalties[mask] += (
+        proc.effective_balances[mask] * scores[mask] // penalty_den
+    )
+    return rewards, penalties
+
+
+def process_rewards_and_penalties(cfg, state, proc: AltairEpochProcess) -> None:
+    if proc.current_epoch == GENESIS_EPOCH:
+        return
+    rewards, penalties = get_flag_deltas(cfg, state, proc)
+    balances = np.array(state.balances, dtype=np.int64)
+    balances = np.maximum(0, balances + rewards - penalties)
+    for i, b in enumerate(balances):
+        state.balances[i] = int(b)
+    proc.balances = balances
+
+
+def process_slashings(cfg, state, proc: AltairEpochProcess) -> None:
+    epoch = proc.current_epoch
+    total_balance = proc.total_active_balance
+    total_slashings = sum(state.slashings)
+    mult = min(
+        total_slashings * _p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR, total_balance
+    )
+    for i, v in enumerate(state.validators):
+        if (
+            v.slashed
+            and epoch + _p.EPOCHS_PER_SLASHINGS_VECTOR // 2 == v.withdrawable_epoch
+        ):
+            increment = _p.EFFECTIVE_BALANCE_INCREMENT
+            penalty_numerator = v.effective_balance // increment * mult
+            penalty = penalty_numerator // total_balance * increment
+            state.balances[i] = max(0, state.balances[i] - penalty)
+
+
+def process_participation_flag_updates(cfg, state, proc) -> None:
+    state.previous_epoch_participation = list(state.current_epoch_participation)
+    state.current_epoch_participation = [0] * len(state.validators)
+
+
+def process_sync_committee_updates(cfg, state, proc, epoch_ctx: EpochContext) -> None:
+    next_epoch = proc.current_epoch + 1
+    if next_epoch % _p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
+        state.current_sync_committee = state.next_sync_committee
+        committee, _ = get_next_sync_committee(
+            state,
+            epoch_ctx.next_shuffling.active_indices,
+            [v.effective_balance for v in state.validators],
+        )
+        state.next_sync_committee = committee
+        # invalidate the cached committee-indices lookup
+        if hasattr(epoch_ctx, "_sync_committee_indices"):
+            del epoch_ctx._sync_committee_indices
+
+
+def process_epoch(cfg, state, epoch_ctx: EpochContext) -> AltairEpochProcess:
+    proc = before_process_epoch(cfg, state, epoch_ctx)
+    process_justification_and_finalization(cfg, state, proc)
+    process_inactivity_updates(cfg, state, proc)
+    process_rewards_and_penalties(cfg, state, proc)
+    e0.process_registry_updates(cfg, state, proc, epoch_ctx)
+    process_slashings(cfg, state, proc)
+    e0.process_eth1_data_reset(cfg, state, proc)
+    e0.process_effective_balance_updates(cfg, state, proc)
+    e0.process_slashings_reset(cfg, state, proc)
+    e0.process_randao_mixes_reset(cfg, state, proc)
+    e0.process_historical_roots_update(cfg, state, proc)
+    process_participation_flag_updates(cfg, state, proc)
+    process_sync_committee_updates(cfg, state, proc, epoch_ctx)
+    return proc

@@ -127,6 +127,12 @@ def get_block_signature_sets(
     sets.extend(get_attestations_signature_sets(cfg, state, epoch_ctx, block))
     for ex in block.body.voluntary_exits:
         sets.append(get_voluntary_exit_signature_set(cfg, state, ex))
+    if hasattr(block.body, "sync_aggregate"):
+        from .block.altair import get_sync_aggregate_signature_set
+
+        s = get_sync_aggregate_signature_set(cfg, state, epoch_ctx, block)
+        if s is not None:
+            sets.append(s)
     # deposits carry their own proof-of-possession checked inline
     # (processDeposit) because the pubkey may be brand new — same as the
     # reference (signatureSets/index.ts comment).

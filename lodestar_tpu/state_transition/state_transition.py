@@ -11,12 +11,27 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-from lodestar_tpu.params import ACTIVE_PRESET as _p
-from lodestar_tpu.types import ssz
-from .block import phase0 as block_phase0
-from .epoch import phase0 as epoch_phase0
+from lodestar_tpu.params import ACTIVE_PRESET as _p, FORK_SEQ, ForkName
+from lodestar_tpu.types import fork_of_block, fork_of_state, ssz, types_for
+from .block import altair as block_altair, phase0 as block_phase0
+from .epoch import altair as epoch_altair, phase0 as epoch_phase0
 from .epoch_context import EpochContext
 from .util.misc import compute_epoch_at_slot
+
+# per-fork processor dispatch (the reference's allForks indirection,
+# state-transition/src/stateTransition.ts processBlock/processEpoch switch)
+_PROCESSORS = {
+    ForkName.phase0: (block_phase0, epoch_phase0),
+    ForkName.altair: (block_altair, epoch_altair),
+}
+
+
+def processors_for(state):
+    return _PROCESSORS[fork_of_state(state)]
+
+
+def state_hash_tree_root(state) -> bytes:
+    return type(state).hash_tree_root(state)
 
 
 class CachedBeaconState:
@@ -37,12 +52,12 @@ class CachedBeaconState:
         return new
 
     def hash_tree_root(self) -> bytes:
-        return ssz.phase0.BeaconState.hash_tree_root(self.state)
+        return state_hash_tree_root(self.state)
 
 
 def process_slot(cfg, state) -> None:
     """Cache state/block roots for the slot about to end."""
-    prev_state_root = ssz.phase0.BeaconState.hash_tree_root(state)
+    prev_state_root = state_hash_tree_root(state)
     state.state_roots[state.slot % _p.SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
     if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
         state.latest_block_header.state_root = prev_state_root
@@ -59,9 +74,23 @@ def process_slots(cached: CachedBeaconState, slot: int) -> None:
     while state.slot < slot:
         process_slot(cached.cfg, state)
         if (state.slot + 1) % _p.SLOTS_PER_EPOCH == 0:
-            epoch_phase0.process_epoch(cached.cfg, state, cached.epoch_ctx)
+            _, epoch_mod = processors_for(state)
+            epoch_mod.process_epoch(cached.cfg, state, cached.epoch_ctx)
             state.slot += 1
             cached.epoch_ctx.rotate(state)
+            # fork upgrade at the boundary (stateTransition.ts processSlots
+            # upgrade hook)
+            next_epoch = compute_epoch_at_slot(state.slot)
+            if (
+                fork_of_state(state) is ForkName.phase0
+                and next_epoch == cached.cfg.ALTAIR_FORK_EPOCH
+            ):
+                from .upgrade import upgrade_to_altair
+
+                cached.state = upgrade_to_altair(
+                    cached.cfg, state, cached.epoch_ctx
+                )
+                state = cached.state
         else:
             state.slot += 1
 
@@ -86,7 +115,12 @@ def state_transition(
             get_block_proposer_signature_set(post.cfg, post.state, post.epoch_ctx, signed_block)
         ):
             raise ValueError("invalid block signature")
-    block_phase0.process_block(
+    block_mod, _ = processors_for(post.state)
+    if fork_of_block(block) is not fork_of_state(post.state):
+        raise ValueError(
+            f"block fork {fork_of_block(block)} != state fork {fork_of_state(post.state)}"
+        )
+    block_mod.process_block(
         post.cfg, post.state, post.epoch_ctx, block, verify_signatures
     )
     if verify_state_root:

@@ -1,0 +1,85 @@
+"""Fork upgrades (reference:
+packages/state-transition/src/slot/upgradeStateToAltair.ts; consensus-specs
+altair/fork.md upgrade_to_altair).
+"""
+from __future__ import annotations
+
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+)
+from lodestar_tpu.types import ssz
+from .epoch_context import EpochContext
+from .util.misc import (
+    compute_epoch_at_slot,
+    get_block_root,
+    get_block_root_at_slot,
+)
+from .util.sync_committee import get_next_sync_committee
+
+
+def _translate_participation(post, epoch_ctx: EpochContext, pending_attestations) -> None:
+    """Spec translate_participation: replay phase0 PendingAttestations into
+    previous-epoch participation flags."""
+    from .block.altair import get_attestation_participation_flag_indices
+
+    for att in pending_attestations:
+        data = att.data
+        try:
+            flag_indices = get_attestation_participation_flag_indices(
+                None, post, data, att.inclusion_delay
+            )
+        except ValueError:
+            continue
+        committee = epoch_ctx.get_committee(data.slot, data.index)
+        for i, bit in enumerate(att.aggregation_bits):
+            if not bit:
+                continue
+            index = int(committee[i])
+            for flag_index in flag_indices:
+                post.previous_epoch_participation[index] |= 1 << flag_index
+
+
+def upgrade_to_altair(cfg, state, epoch_ctx: EpochContext):
+    """phase0 BeaconState -> altair BeaconState at the fork boundary."""
+    epoch = compute_epoch_at_slot(state.slot)
+    n = len(state.validators)
+    post = ssz.altair.BeaconState(
+        genesis_time=state.genesis_time,
+        genesis_validators_root=bytes(state.genesis_validators_root),
+        slot=state.slot,
+        fork=ssz.phase0.Fork(
+            previous_version=bytes(state.fork.current_version),
+            current_version=cfg.ALTAIR_FORK_VERSION,
+            epoch=epoch,
+        ),
+        latest_block_header=state.latest_block_header,
+        block_roots=list(state.block_roots),
+        state_roots=list(state.state_roots),
+        historical_roots=list(state.historical_roots),
+        eth1_data=state.eth1_data,
+        eth1_data_votes=list(state.eth1_data_votes),
+        eth1_deposit_index=state.eth1_deposit_index,
+        validators=list(state.validators),
+        balances=list(state.balances),
+        randao_mixes=list(state.randao_mixes),
+        slashings=list(state.slashings),
+        previous_epoch_participation=[0] * n,
+        current_epoch_participation=[0] * n,
+        justification_bits=list(state.justification_bits),
+        previous_justified_checkpoint=state.previous_justified_checkpoint,
+        current_justified_checkpoint=state.current_justified_checkpoint,
+        finalized_checkpoint=state.finalized_checkpoint,
+        inactivity_scores=[0] * n,
+    )
+    _translate_participation(post, epoch_ctx, state.previous_epoch_attestations)
+
+    eff = [v.effective_balance for v in post.validators]
+    committee, _ = get_next_sync_committee(
+        post, epoch_ctx.next_shuffling.active_indices, eff
+    )
+    post.current_sync_committee = committee
+    post.next_sync_committee = committee
+    return post

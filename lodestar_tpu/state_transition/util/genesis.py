@@ -163,4 +163,12 @@ def init_dev_state(
     )
     if genesis_time is not None:
         state.genesis_time = genesis_time
+    if cfg.ALTAIR_FORK_EPOCH == 0:
+        # altair-from-genesis dev nets: upgrade the phase0 genesis in place
+        # (the reference's getGenesisBeaconState upgrades per fork schedule)
+        from ..epoch_context import EpochContext
+        from ..upgrade import upgrade_to_altair
+
+        state = upgrade_to_altair(cfg, state, EpochContext(state))
+        state.fork.previous_version = cfg.GENESIS_FORK_VERSION
     return deposits, state

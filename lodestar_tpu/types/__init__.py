@@ -19,3 +19,95 @@ class _Ssz:
 
 
 ssz = _Ssz()
+
+
+# fork registry ---------------------------------------------------------------
+
+from lodestar_tpu.params import ForkName  # noqa: E402
+
+_STATE_TYPES = {
+    ForkName.phase0: phase0.BeaconState,
+    ForkName.altair: altair.BeaconState,
+}
+_BLOCK_TYPES = {
+    ForkName.phase0: phase0.BeaconBlock,
+    ForkName.altair: altair.BeaconBlock,
+}
+_SIGNED_BLOCK_TYPES = {
+    ForkName.phase0: phase0.SignedBeaconBlock,
+    ForkName.altair: altair.SignedBeaconBlock,
+}
+_BODY_TYPES = {
+    ForkName.phase0: phase0.BeaconBlockBody,
+    ForkName.altair: altair.BeaconBlockBody,
+}
+
+
+def fork_of_state(state) -> ForkName:
+    """Which fork a BeaconState instance belongs to (by container type —
+    the reference dispatches on allForks types the same way)."""
+    for fork, t in _STATE_TYPES.items():
+        if isinstance(state, t):
+            return fork
+    raise TypeError(f"unknown state type {type(state)!r}")
+
+
+def fork_of_block(block) -> ForkName:
+    for fork, t in _BLOCK_TYPES.items():
+        if isinstance(block, t):
+            return fork
+    for fork, t in _SIGNED_BLOCK_TYPES.items():
+        if isinstance(block, t):
+            return fork
+    raise TypeError(f"unknown block type {type(block)!r}")
+
+
+def types_for(fork: ForkName):
+    """(BeaconState, BeaconBlock, SignedBeaconBlock, BeaconBlockBody)."""
+    return (
+        _STATE_TYPES[fork],
+        _BLOCK_TYPES[fork],
+        _SIGNED_BLOCK_TYPES[fork],
+        _BODY_TYPES[fork],
+    )
+
+
+class SignedBlockSlotCodec:
+    """Wire codec for SignedBeaconBlock that resolves the fork from the
+    block's SLOT (the reference's config.getForkTypes(slot) pattern):
+    SignedBeaconBlock serializes as [4-byte message offset | 96-byte
+    signature | message...], so the message's leading slot uint64 always
+    sits at bytes 100..108 regardless of fork.
+
+    Must be `configure(cfg)`-ed with the chain config before altair blocks
+    can be decoded; unconfigured it decodes everything as phase0."""
+
+    def __init__(self):
+        self._altair_epoch = None
+
+    def configure(self, cfg) -> None:
+        self._altair_epoch = cfg.ALTAIR_FORK_EPOCH
+
+    def fork_at_slot(self, slot: int) -> ForkName:
+        from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+        if (
+            self._altair_epoch is not None
+            and slot // _p.SLOTS_PER_EPOCH >= self._altair_epoch
+        ):
+            return ForkName.altair
+        return ForkName.phase0
+
+    def serialize(self, sb) -> bytes:
+        return type(sb).serialize(sb)
+
+    def deserialize(self, data: bytes):
+        if len(data) < 108:
+            raise ValueError("signed block too short")
+        slot = int.from_bytes(data[100:108], "little")
+        return _SIGNED_BLOCK_TYPES[self.fork_at_slot(slot)].deserialize(data)
+
+
+# process-wide instance shared by reqresp protocol tables and gossip topic
+# registrations (configured by Network.__init__ from the chain config)
+signed_block_wire_codec = SignedBlockSlotCodec()

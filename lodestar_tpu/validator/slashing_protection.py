@@ -183,8 +183,16 @@ class SlashingProtection:
                 max_source = max(max_source, src)
                 max_target = max(max_target, tgt)
             if max_source >= 0:
+                # EIP-3076: merge with existing data — never LOWER a stored
+                # bound (importing an old interchange after a newer one must
+                # not weaken protection).
+                lb_key = _k(Bucket.phase0_slashingProtectionAttestationLowerBound, pk)
+                existing = self.db.get(lb_key)
+                if existing is not None:
+                    max_source = max(max_source, int.from_bytes(existing[:8], "big"))
+                    max_target = max(max_target, int.from_bytes(existing[8:16], "big"))
                 self.db.put(
-                    _k(Bucket.phase0_slashingProtectionAttestationLowerBound, pk),
+                    lb_key,
                     max(0, max_source).to_bytes(8, "big")
                     + max(0, max_target).to_bytes(8, "big"),
                 )

@@ -1,0 +1,134 @@
+"""Altair fork tests: altair-from-genesis dev chain, the phase0->altair
+upgrade at the fork boundary, sync aggregate processing/signatures, and
+altair epoch processing (participation flags, inactivity, sync committee
+rotation).
+
+Mirrors the reference's altair spec suites (test/spec/presets/
+{epoch_processing,operations,sanity}.ts altair branches) at dev-chain
+scale on the minimal preset.
+"""
+import dataclasses
+
+import pytest
+
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ACTIVE_PRESET_NAME, ForkName
+from lodestar_tpu.state_transition import CachedBeaconState
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.types import fork_of_state, ssz
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+E = _p.SLOTS_PER_EPOCH
+
+altair_cfg = dataclasses.replace(minimal_chain_config, ALTAIR_FORK_EPOCH=0)
+fork1_cfg = dataclasses.replace(minimal_chain_config, ALTAIR_FORK_EPOCH=1)
+
+
+class TestAltairGenesis:
+    def test_genesis_is_altair(self):
+        _, state = init_dev_state(altair_cfg, 8, genesis_time=0)
+        assert fork_of_state(state) is ForkName.altair
+        assert bytes(state.fork.current_version) == altair_cfg.ALTAIR_FORK_VERSION
+        assert len(state.inactivity_scores) == 8
+        assert len(state.previous_epoch_participation) == 8
+        # sync committees populated with registered pubkeys
+        pks = {bytes(v.pubkey) for v in state.validators}
+        assert all(bytes(pk) in pks for pk in state.current_sync_committee.pubkeys)
+        assert ssz.altair.BeaconState.hash_tree_root(state)
+
+
+@pytest.fixture(scope="module")
+def altair_chain():
+    chain = DevChain(altair_cfg, validator_count=8, genesis_time=0)
+    chain.run_until(4 * E + 1, verify_signatures=False)
+    return chain
+
+
+class TestAltairDevChain:
+    def test_advances_and_finalizes(self, altair_chain):
+        st = altair_chain.head.state
+        assert st.slot == 4 * E + 1
+        assert fork_of_state(st) is ForkName.altair
+        assert st.current_justified_checkpoint.epoch >= 3
+        assert st.finalized_checkpoint.epoch >= 2
+
+    def test_participation_flags_set(self, altair_chain):
+        st = altair_chain.head.state
+        # full participation: every validator has source+target flags in
+        # the previous epoch
+        assert all(p & 0b11 == 0b11 for p in st.previous_epoch_participation)
+
+    def test_balances_grow(self, altair_chain):
+        st = altair_chain.head.state
+        assert all(b > 32_000_000_000 for b in st.balances)
+
+    def test_sync_committee_rotates(self, altair_chain):
+        """minimal preset EPOCHS_PER_SYNC_COMMITTEE_PERIOD=8: after 4
+        epochs no rotation yet, but next != garbage; run a chain past the
+        period boundary to see current <- next."""
+        chain = DevChain(altair_cfg, validator_count=8, genesis_time=0)
+        period = _p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        # dial (without blocks) across the period boundary
+        from lodestar_tpu.state_transition import process_slots
+
+        st = chain.head.clone()
+        before_next = [bytes(pk) for pk in st.state.next_sync_committee.pubkeys]
+        process_slots(st, period * E + 1)
+        after_current = [bytes(pk) for pk in st.state.current_sync_committee.pubkeys]
+        assert after_current == before_next
+
+    def test_real_sync_aggregate_signatures(self):
+        """Blocks carry full-participation sync aggregates; the sets
+        (incl. the sync committee set) verify through the oracle."""
+        chain = DevChain(altair_cfg, validator_count=8, genesis_time=0)
+        chain.run_until(E + 1, verify_signatures=True)
+        assert chain.head.state.slot == E + 1
+
+    def test_corrupt_sync_aggregate_rejected(self):
+        from lodestar_tpu.state_transition import state_transition
+
+        chain = DevChain(altair_cfg, validator_count=8, genesis_time=0)
+        block = chain.produce_block(1)
+        sig = bytearray(bytes(block.message.body.sync_aggregate.sync_committee_signature))
+        sig[10] ^= 0xFF
+        block.message.body.sync_aggregate.sync_committee_signature = bytes(sig)
+        with pytest.raises(ValueError):
+            state_transition(
+                chain.head, block,
+                verify_state_root=False, verify_proposer=False,
+                verify_signatures=True,
+            )
+
+
+class TestForkUpgrade:
+    def test_upgrade_at_epoch_1(self):
+        """phase0 genesis, ALTAIR_FORK_EPOCH=1: the chain crosses the fork
+        boundary mid-run, the state becomes altair with translated
+        participation, and finality still advances."""
+        chain = DevChain(fork1_cfg, validator_count=8, genesis_time=0)
+        assert fork_of_state(chain.head.state) is ForkName.phase0
+        chain.run_until(4 * E + 1, verify_signatures=False)
+        st = chain.head.state
+        assert fork_of_state(st) is ForkName.altair
+        assert bytes(st.fork.current_version) == fork1_cfg.ALTAIR_FORK_VERSION
+        assert bytes(st.fork.previous_version) == fork1_cfg.GENESIS_FORK_VERSION
+        assert st.finalized_checkpoint.epoch >= 2
+        # upgraded registries got the altair per-validator lists
+        assert len(st.inactivity_scores) == len(st.validators)
+
+    def test_translated_participation_nonzero(self):
+        """The upgrade replays phase0 pending attestations into previous
+        epoch participation (spec translate_participation)."""
+        from lodestar_tpu.state_transition import process_slots
+
+        chain = DevChain(fork1_cfg, validator_count=8, genesis_time=0)
+        chain.run_until(E - 1, verify_signatures=False)  # stay in phase0
+        st = chain.head.clone()
+        assert fork_of_state(st.state) is ForkName.phase0
+        process_slots(st, E)  # cross the boundary -> upgrade
+        assert fork_of_state(st.state) is ForkName.altair
+        assert any(p != 0 for p in st.state.previous_epoch_participation)

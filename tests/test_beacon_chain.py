@@ -128,3 +128,22 @@ def test_regen_replays_missing_state():
         await chain.close()
 
     asyncio.run(go())
+
+
+class TestStateCachePinning:
+    def test_pinned_anchor_survives_eviction(self):
+        """ADVICE r2 (low): the anchor/finalized state is regen's terminal
+        ancestor and must never be LRU-evicted."""
+        from lodestar_tpu.chain.regen import StateContextCache
+
+        c = StateContextCache(max_states=2)
+        c.add(b"\x00" * 32, "anchor")
+        c.pin(b"\x00" * 32)
+        for i in range(1, 5):
+            c.add(bytes([i]) * 32, f"s{i}")
+        assert c.get(b"\x00" * 32) == "anchor"
+        assert len(c) == 2
+        c.unpin(b"\x00" * 32)
+        c.add(b"\x05" * 32, "s5")
+        c.add(b"\x06" * 32, "s6")  # anchor (now unpinned + LRU) evicted
+        assert c.get(b"\x00" * 32) is None

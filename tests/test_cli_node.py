@@ -1,0 +1,109 @@
+"""CLI process smoke test: a `beacon` node process + a `validator` client
+process over the REST seam (reference: cmds/beacon + cmds/validator wired
+the same way in the sim tests, test/sim/).
+
+Genesis is set in the past so the validator races through its slots
+without wall-clock waits; the beacon node must import the produced blocks
+and advance its head.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from lodestar_tpu.params import ACTIVE_PRESET_NAME
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read())
+
+
+class TestBeaconValidatorProcesses:
+    def test_beacon_plus_validator_over_rest(self):
+        rest = _free_port()
+        metrics = _free_port()
+        env = dict(
+            os.environ,
+            LODESTAR_TPU_PRESET="minimal",
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+        )
+        genesis_time = int(time.time()) - 6 * 30  # clock already at slot ~30
+        beacon = subprocess.Popen(
+            [
+                sys.executable, "-m", "lodestar_tpu.cli.main", "beacon",
+                "--validators", "8", "--genesis-time", str(genesis_time),
+                "--rest-port", str(rest), "--metrics-port", str(metrics),
+            ],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        )
+        try:
+            # wait for the REST server
+            deadline = time.time() + 300
+            up = False
+            while time.time() < deadline:
+                try:
+                    _get(f"http://127.0.0.1:{rest}/eth/v1/node/health")
+                    up = True
+                    break
+                except Exception:
+                    if beacon.poll() is not None:
+                        raise AssertionError("beacon process died")
+                    time.sleep(0.5)
+            assert up, "beacon REST never came up"
+
+            genesis = _get(f"http://127.0.0.1:{rest}/eth/v1/beacon/genesis")["data"]
+            assert int(genesis["genesis_time"]) == genesis_time
+
+            validator = subprocess.run(
+                [
+                    sys.executable, "-m", "lodestar_tpu.cli.main", "validator",
+                    "--beacon-url", f"http://127.0.0.1:{rest}",
+                    "--interop-indices", "0..7", "--slots", "5",
+                ],
+                env=env, cwd=REPO, timeout=600,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            out = validator.stdout.decode()
+            assert validator.returncode == 0, out[-2000:]
+            lines = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+            assert lines, out[-2000:]
+            assert lines[-1]["proposed"] >= 1, out[-2000:]
+
+            hdr = _get(f"http://127.0.0.1:{rest}/eth/v1/beacon/headers/head")["data"]
+            assert int(hdr["header"]["message"]["slot"]) >= 1
+
+            # metrics endpoint exposes head slot
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+            assert "beacon_head_slot" in text
+        finally:
+            beacon.send_signal(signal.SIGINT)
+            try:
+                beacon.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                beacon.kill()

@@ -204,3 +204,64 @@ class TestUnrealizedPullUp:
         b.unrealized_justified_root = GENESIS
         fc.on_block(b, 99, fc.store.justified, fc.store.finalized)
         assert fc.store.justified.epoch == 1           # immediate
+
+
+class TestJustifiedBalancesGetter:
+    def test_on_tick_pull_up_refreshes_balances(self):
+        """ADVICE r2 (high): the epoch-boundary pull-up passes no balances;
+        the store must refresh them via the justified-balances getter so
+        LMD weights/proposer boost never run on stale anchor-era balances."""
+        fresh = [7] * 4
+        calls = []
+
+        def getter(checkpoint):
+            calls.append(checkpoint)
+            return fresh
+
+        arr = ProtoArray.initialize(block(0, GENESIS, root(0xFF, 0xFF)), current_slot=1)
+        store = ForkChoiceStore(
+            current_slot=1,
+            justified=CheckpointHex(0, GENESIS),
+            justified_balances=[32] * 4,
+            finalized=CheckpointHex(0, GENESIS),
+            unrealized_justified=CheckpointHex(0, GENESIS),
+            unrealized_finalized=CheckpointHex(0, GENESIS),
+        )
+        fc = ForkChoice(cfg, store, arr, proposer_boost_enabled=False,
+                        justified_balances_getter=getter)
+        e = _p.SLOTS_PER_EPOCH
+        fc.update_time(e + 1)
+        b = block(e + 1, root(0xD1), GENESIS)
+        b.unrealized_justified_epoch = 1
+        b.unrealized_justified_root = GENESIS
+        fc.on_block(b, 99, fc.store.justified, fc.store.finalized)
+        assert fc.store.justified_balances == [32] * 4  # deferred, unchanged
+        fc.update_time(2 * e)  # boundary pull-up: no balances in hand
+        assert fc.store.justified.epoch == 1
+        assert calls and calls[-1].epoch == 1
+        assert fc.store.justified_balances == fresh
+
+    def test_explicit_balances_still_take_precedence(self):
+        def getter(checkpoint):
+            raise AssertionError("getter must not be called when balances given")
+
+        arr = ProtoArray.initialize(block(0, GENESIS, root(0xFF, 0xFF)), current_slot=1)
+        store = ForkChoiceStore(
+            current_slot=1,
+            justified=CheckpointHex(0, GENESIS),
+            justified_balances=[32] * 4,
+            finalized=CheckpointHex(0, GENESIS),
+            unrealized_justified=CheckpointHex(0, GENESIS),
+            unrealized_finalized=CheckpointHex(0, GENESIS),
+        )
+        fc = ForkChoice(cfg, store, arr, proposer_boost_enabled=False,
+                        justified_balances_getter=getter)
+        e = _p.SLOTS_PER_EPOCH
+        fc.update_time(2 * e + 1)
+        b = block(e, root(0xD2), GENESIS)
+        b.unrealized_justified_epoch = 1
+        b.unrealized_justified_root = GENESIS
+        fc.on_block(b, 99, fc.store.justified, fc.store.finalized,
+                    justified_balances=[9] * 4)
+        assert fc.store.justified.epoch == 1
+        assert fc.store.justified_balances == [9] * 4

@@ -260,3 +260,96 @@ class TestGossipBlock:
             assert e.value.code == GossipErrorCode.UNKNOWN_BEACON_BLOCK_ROOT
 
         asyncio.run(go())
+
+
+@pytest.fixture()
+def epoch_boundary_chain():
+    """Chain imported through slot SLOTS_PER_EPOCH-1 (head still in epoch 0)
+    with the clock at the first slot of epoch 1."""
+    from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+    e = _p.SLOTS_PER_EPOCH
+    dev = DevChain(cfg, 8, genesis_time=0)
+    _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+    ft = FakeTime(0.0)
+    chain = BeaconChain(
+        cfg, BeaconDb(), anchor, clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=ft)
+    )
+
+    async def setup():
+        for slot in range(1, e):
+            ft.t = slot * cfg.SECONDS_PER_SLOT
+            block = dev.produce_block(slot)
+            dev.import_block(block, verify_signatures=False)
+            await chain.process_block(block)
+
+    asyncio.run(setup())
+    ft.t = e * cfg.SECONDS_PER_SLOT  # clock now in epoch 1, head in epoch 0
+    return dev, chain, ft
+
+
+class TestEpochBoundaryValidation:
+    def test_first_block_of_new_epoch_proposer_checked(self, epoch_boundary_chain):
+        """ADVICE r2 (medium): blocks in a new epoch (head state still in
+        the prior epoch) must STILL get the proposer-index check — the
+        validation state is dialed forward to the block's slot."""
+        from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+        dev, chain, ft = epoch_boundary_chain
+        e = _p.SLOTS_PER_EPOCH
+        good = dev.produce_block(e)
+        bad = ssz.phase0.SignedBeaconBlock(
+            message=ssz.phase0.BeaconBlock(
+                slot=good.message.slot,
+                # wrong proposer (shift by one; 8 validators)
+                proposer_index=(good.message.proposer_index + 1) % 8,
+                parent_root=bytes(good.message.parent_root),
+                state_root=bytes(good.message.state_root),
+                body=good.message.body,
+            ),
+            signature=bytes(good.signature),
+        )
+        with pytest.raises(GossipValidationError) as exc:
+            asyncio.run(validate_gossip_block(chain, bad))
+        assert exc.value.code == GossipErrorCode.BLOCK_SLOT_MISMATCH
+        # the honest block passes end-to-end
+        asyncio.run(validate_gossip_block(chain, good))
+
+    def test_new_epoch_attestation_committee_from_target_state(
+        self, epoch_boundary_chain
+    ):
+        """ADVICE r2 (medium): committee resolution must follow the
+        attestation's TARGET checkpoint state, so epoch-1 attestations
+        validate while the head state still sits in epoch 0."""
+        from lodestar_tpu.params import ACTIVE_PRESET as _p
+        from lodestar_tpu.state_transition.util.misc import (
+            compute_epoch_at_slot,
+        )
+
+        dev, chain, ft = epoch_boundary_chain
+        e = _p.SLOTS_PER_EPOCH
+        slot = e  # first slot of epoch 1; no epoch-1 block exists yet
+        head_root = chain.head_root
+        target = ssz.phase0.Checkpoint(epoch=1, root=head_root)
+        cp_state = chain.get_checkpoint_state(1, head_root)
+        assert cp_state is not None
+        committee = cp_state.epoch_ctx.get_committee(slot, 0)
+        st = cp_state.state
+        data = ssz.phase0.AttestationData(
+            slot=slot,
+            index=0,
+            beacon_block_root=head_root,
+            source=st.current_justified_checkpoint,
+            target=target,
+        )
+        domain = get_domain(cfg, st, DOMAIN_BEACON_ATTESTER, 1)
+        root = compute_signing_root(ssz.phase0.AttestationData, data, domain)
+        attester = int(committee[0])
+        bits = [False] * len(committee)
+        bits[0] = True
+        sig = dev.sks[attester].sign(root)
+        att = ssz.phase0.Attestation(
+            aggregation_bits=bits, data=data, signature=sig.to_bytes()
+        )
+        got = asyncio.run(validate_gossip_attestation(chain, att))
+        assert got == [attester]

@@ -1,0 +1,142 @@
+"""Light client end-to-end: server produces bootstrap/updates from an
+altair dev chain; client initializes from a trusted root and follows
+finality (reference: packages/light-client test flow +
+chain/lightClient server).
+"""
+import asyncio
+import dataclasses
+
+import pytest
+
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.chain.light_client_server import LightClientServer
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.light_client import LightClient, LightClientError
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ACTIVE_PRESET_NAME
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.types import ssz
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+E = _p.SLOTS_PER_EPOCH
+altair_cfg = dataclasses.replace(minimal_chain_config, ALTAIR_FORK_EPOCH=0)
+
+
+class FakeTime:
+    def __init__(self, t):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def lc_chain():
+    """Altair chain imported through 3 epochs with a LightClientServer
+    attached; signature verification OFF in the dev mirror but ON in the
+    node pipeline for the first few blocks only would be slow — here the
+    chain pipeline verifies everything (8 validators, minimal preset)."""
+    dev = DevChain(altair_cfg, 8, genesis_time=0)
+    _, anchor = init_dev_state(altair_cfg, 8, genesis_time=0)
+    ft = FakeTime(0.0)
+    chain = BeaconChain(
+        altair_cfg, BeaconDb(), anchor,
+        clock=LocalClock(0, altair_cfg.SECONDS_PER_SLOT, now=ft),
+    )
+    server = LightClientServer(chain)
+
+    async def run():
+        for slot in range(1, 3 * E + 1):
+            ft.t = slot * altair_cfg.SECONDS_PER_SLOT
+            dev.attest(slot - 1) if slot > 1 else None
+            block = dev.produce_block(slot)
+            dev.import_block(block, verify_signatures=False)
+            await chain.process_block(block)
+
+    asyncio.run(run())
+    return dev, chain, server
+
+
+class TestLightClientServer:
+    def test_bootstrap_available_and_valid(self, lc_chain):
+        dev, chain, server = lc_chain
+        # first imported block
+        root = next(iter(dev.blocks))
+        bootstrap = server.get_bootstrap(root)
+        assert bootstrap is not None
+        lc = LightClient.initialize_from_checkpoint_root(
+            altair_cfg, chain.genesis_validators_root, root, bootstrap
+        )
+        assert lc.store.finalized_header.slot == bootstrap.header.slot
+
+    def test_bad_bootstrap_rejected(self, lc_chain):
+        dev, chain, server = lc_chain
+        root = next(iter(dev.blocks))
+        bootstrap = server.get_bootstrap(root)
+        with pytest.raises(LightClientError):
+            LightClient.initialize_from_checkpoint_root(
+                altair_cfg, chain.genesis_validators_root, b"\x42" * 32, bootstrap
+            )
+        # tamper with the branch
+        bad = ssz.altair.LightClientBootstrap(
+            header=bootstrap.header,
+            current_sync_committee=bootstrap.current_sync_committee,
+            current_sync_committee_branch=[b"\x13" * 32] * 5,
+        )
+        with pytest.raises(LightClientError):
+            LightClient.initialize_from_checkpoint_root(
+                altair_cfg, chain.genesis_validators_root, root, bad
+            )
+
+    def test_updates_follow_finality(self, lc_chain):
+        dev, chain, server = lc_chain
+        root = next(iter(dev.blocks))
+        bootstrap = server.get_bootstrap(root)
+        lc = LightClient.initialize_from_checkpoint_root(
+            altair_cfg, chain.genesis_validators_root, root, bootstrap
+        )
+        update = server.get_update(0)
+        assert update is not None, "server should have a best update for period 0"
+        lc.process_update(update)
+        assert lc.store.finalized_header.slot > 0
+        assert lc.store.next_sync_committee is not None
+        # optimistic header tracks the attested tip
+        assert lc.store.optimistic_header.slot >= lc.store.finalized_header.slot
+        # the latest finality update advances further (or is equal)
+        if server.latest_finality_update is not None:
+            lc.process_finality_update(server.latest_finality_update)
+            assert (
+                lc.store.finalized_header.slot
+                == server.latest_finality_update.finalized_header.slot
+            )
+
+    def test_corrupt_update_rejected(self, lc_chain):
+        dev, chain, server = lc_chain
+        root = next(iter(dev.blocks))
+        lc = LightClient.initialize_from_checkpoint_root(
+            altair_cfg, chain.genesis_validators_root, root, server.get_bootstrap(root)
+        )
+        update = server.get_update(0)
+        bad_sig = bytearray(
+            bytes(update.sync_aggregate.sync_committee_signature)
+        )
+        bad_sig[5] ^= 0x55
+        bad = ssz.altair.LightClientUpdate(
+            attested_header=update.attested_header,
+            next_sync_committee=update.next_sync_committee,
+            next_sync_committee_branch=list(update.next_sync_committee_branch),
+            finalized_header=update.finalized_header,
+            finality_branch=list(update.finality_branch),
+            sync_aggregate=ssz.altair.SyncAggregate(
+                sync_committee_bits=list(update.sync_aggregate.sync_committee_bits),
+                sync_committee_signature=bytes(bad_sig),
+            ),
+            signature_slot=update.signature_slot,
+        )
+        with pytest.raises(LightClientError, match="signature"):
+            lc.process_update(bad)

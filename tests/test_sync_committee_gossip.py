@@ -1,0 +1,230 @@
+"""Sync committee gossip + pools (altair): message validation, naive
+aggregation into contributions, contribution-and-proof validation, and
+block SyncAggregate assembly from the pool (reference:
+chain/validation/syncCommittee*.ts + opPools/syncCommittee*Pool.ts).
+"""
+import asyncio
+import dataclasses
+
+import pytest
+
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.chain.validation import (
+    GossipErrorCode,
+    GossipValidationError,
+    validate_sync_committee_contribution,
+    validate_sync_committee_message,
+)
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    ACTIVE_PRESET_NAME,
+    DOMAIN_CONTRIBUTION_AND_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+    SYNC_COMMITTEE_SUBNET_SIZE,
+)
+from lodestar_tpu.state_transition.block.phase0 import get_domain
+from lodestar_tpu.state_transition.util.domain import compute_signing_root
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.types import ssz
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+altair_cfg = dataclasses.replace(minimal_chain_config, ALTAIR_FORK_EPOCH=0)
+
+
+class FakeTime:
+    def __init__(self, t):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture()
+def sync_chain():
+    dev = DevChain(altair_cfg, 8, genesis_time=0)
+    _, anchor = init_dev_state(altair_cfg, 8, genesis_time=0)
+    ft = FakeTime(0.0)
+    chain = BeaconChain(
+        altair_cfg, BeaconDb(), anchor,
+        clock=LocalClock(0, altair_cfg.SECONDS_PER_SLOT, now=ft),
+    )
+
+    async def setup():
+        for slot in (1, 2):
+            ft.t = slot * altair_cfg.SECONDS_PER_SLOT
+            block = dev.produce_block(slot)
+            dev.import_block(block, verify_signatures=False)
+            await chain.process_block(block)
+
+    asyncio.run(setup())
+    return dev, chain, ft
+
+
+def make_sync_message(dev, chain, slot, position):
+    """SyncCommitteeMessage by the sync-committee member at `position`."""
+    st = chain.get_head_state().state
+    vindex = chain.get_head_state().epoch_ctx.pubkey2index[
+        bytes(st.current_sync_committee.pubkeys[position])
+    ]
+    domain = get_domain(altair_cfg, st, DOMAIN_SYNC_COMMITTEE, slot // _p.SLOTS_PER_EPOCH)
+    root = compute_signing_root(ssz.phase0.Root, chain.head_root, domain)
+    sig = dev.sks[vindex].sign(root)
+    return (
+        ssz.altair.SyncCommitteeMessage(
+            slot=slot,
+            beacon_block_root=chain.head_root,
+            validator_index=vindex,
+            signature=sig.to_bytes(),
+        ),
+        vindex,
+    )
+
+
+class TestSyncCommitteeMessage:
+    def test_valid_message_accepted_and_pooled(self, sync_chain):
+        dev, chain, ft = sync_chain
+        slot = chain.clock.current_slot
+        position = 0
+        subnet = position // SYNC_COMMITTEE_SUBNET_SIZE
+        msg, vindex = make_sync_message(dev, chain, slot, position)
+        positions = asyncio.run(validate_sync_committee_message(chain, msg, subnet))
+        assert positions  # at least one position in this subcommittee
+        for pos in positions:
+            chain.sync_committee_message_pool.add(subnet, pos, msg)
+        contribution = chain.sync_committee_message_pool.get_contribution(
+            slot, chain.head_root, subnet
+        )
+        assert contribution is not None
+        assert sum(contribution.aggregation_bits) == len(positions)
+
+    def test_duplicate_rejected(self, sync_chain):
+        dev, chain, ft = sync_chain
+        slot = chain.clock.current_slot
+        msg, vindex = make_sync_message(dev, chain, slot, 0)
+        asyncio.run(validate_sync_committee_message(chain, msg, 0))
+        with pytest.raises(GossipValidationError) as e:
+            asyncio.run(validate_sync_committee_message(chain, msg, 0))
+        assert e.value.code == GossipErrorCode.ATTESTER_ALREADY_SEEN
+
+    def test_wrong_subnet_rejected(self, sync_chain):
+        dev, chain, ft = sync_chain
+        slot = chain.clock.current_slot
+        st = chain.get_head_state().state
+        # find a validator present in subcommittee 0 but NOT in subcommittee 1
+        from lodestar_tpu.chain.validation import _sync_committee_positions
+
+        msg, vindex = make_sync_message(dev, chain, slot, 0)
+        positions = _sync_committee_positions(st, vindex)
+        in_sub1 = any(p // SYNC_COMMITTEE_SUBNET_SIZE == 1 for p in positions)
+        if in_sub1:
+            pytest.skip("small dev set: validator sits in every subcommittee")
+        with pytest.raises(GossipValidationError):
+            asyncio.run(validate_sync_committee_message(chain, msg, 1))
+
+    def test_bad_signature_rejected(self, sync_chain):
+        dev, chain, ft = sync_chain
+        slot = chain.clock.current_slot
+        msg, _ = make_sync_message(dev, chain, slot, 0)
+        sig = bytearray(bytes(msg.signature))
+        sig[20] ^= 0x01
+        bad = ssz.altair.SyncCommitteeMessage(
+            slot=msg.slot,
+            beacon_block_root=bytes(msg.beacon_block_root),
+            validator_index=msg.validator_index,
+            signature=bytes(sig),
+        )
+        with pytest.raises((GossipValidationError, ValueError)):
+            asyncio.run(validate_sync_committee_message(chain, bad, 0))
+
+
+class TestContributionAndProof:
+    def _make_contribution(self, dev, chain, subnet=0):
+        slot = chain.clock.current_slot
+        st = chain.get_head_state().state
+        # fill the pool with every member of the subcommittee
+        for i in range(SYNC_COMMITTEE_SUBNET_SIZE):
+            position = subnet * SYNC_COMMITTEE_SUBNET_SIZE + i
+            msg, _ = make_sync_message(dev, chain, slot, position)
+            chain.sync_committee_message_pool.add(subnet, i, msg)
+        contribution = chain.sync_committee_message_pool.get_contribution(
+            slot, chain.head_root, subnet
+        )
+        # aggregator: any subcommittee member (minimal preset modulo == 1)
+        agg_pos = subnet * SYNC_COMMITTEE_SUBNET_SIZE
+        agg_index = chain.get_head_state().epoch_ctx.pubkey2index[
+            bytes(st.current_sync_committee.pubkeys[agg_pos])
+        ]
+        epoch = slot // _p.SLOTS_PER_EPOCH
+        sel_data = ssz.altair.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subnet
+        )
+        sel_domain = get_domain(
+            altair_cfg, st, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch
+        )
+        sel_proof = dev.sks[agg_index].sign(
+            compute_signing_root(
+                ssz.altair.SyncAggregatorSelectionData, sel_data, sel_domain
+            )
+        )
+        cp = ssz.altair.ContributionAndProof(
+            aggregator_index=agg_index,
+            contribution=contribution,
+            selection_proof=sel_proof.to_bytes(),
+        )
+        cap_domain = get_domain(altair_cfg, st, DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
+        sig = dev.sks[agg_index].sign(
+            compute_signing_root(ssz.altair.ContributionAndProof, cp, cap_domain)
+        )
+        return ssz.altair.SignedContributionAndProof(
+            message=cp, signature=sig.to_bytes()
+        )
+
+    def test_valid_contribution_and_block_assembly(self, sync_chain):
+        dev, chain, ft = sync_chain
+        signed = self._make_contribution(dev, chain, subnet=0)
+        asyncio.run(validate_sync_committee_contribution(chain, signed))
+        chain.sync_contribution_pool.add(signed.message.contribution)
+        # assemble a block-level SyncAggregate for the NEXT slot
+        agg = chain.sync_contribution_pool.get_sync_aggregate(
+            chain.clock.current_slot + 1, chain.head_root
+        )
+        assert sum(agg.sync_committee_bits) == SYNC_COMMITTEE_SUBNET_SIZE
+        # its signature must verify as the participants' aggregate
+        st = chain.get_head_state().state
+        pks = [
+            bls.PublicKey.from_bytes(bytes(pk))
+            for pk, b in zip(st.current_sync_committee.pubkeys, agg.sync_committee_bits)
+            if b
+        ]
+        domain = get_domain(
+            altair_cfg, st, DOMAIN_SYNC_COMMITTEE,
+            chain.clock.current_slot // _p.SLOTS_PER_EPOCH,
+        )
+        root = compute_signing_root(ssz.phase0.Root, chain.head_root, domain)
+        assert bls.fast_aggregate_verify(
+            pks, root, bls.Signature.from_bytes(bytes(agg.sync_committee_signature))
+        )
+
+    def test_non_aggregator_rejected_or_skipped(self, sync_chain):
+        from lodestar_tpu.state_transition.util.aggregator import (
+            is_sync_committee_aggregator,
+        )
+
+        dev, chain, ft = sync_chain
+        signed = self._make_contribution(dev, chain, subnet=0)
+        # corrupt the selection proof -> either NOT_AGGREGATOR (modulo) or
+        # INVALID_SIGNATURE (the proof check), both rejections
+        sig = bytearray(bytes(signed.message.selection_proof))
+        sig[30] ^= 0x02
+        signed.message.selection_proof = bytes(sig)
+        with pytest.raises((GossipValidationError, ValueError)):
+            asyncio.run(validate_sync_committee_contribution(chain, signed))

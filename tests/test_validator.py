@@ -166,3 +166,41 @@ class TestValidatorStore:
         assert len(store.sign_randao(pk, 3)) == 96
         with pytest.raises(KeyError):
             store.sign_randao(b"\x00" * 48, 3)
+
+
+class TestInterchangeMerge:
+    def test_import_older_interchange_does_not_lower_bounds(self):
+        """ADVICE r2 (medium): EIP-3076 import must MERGE with existing
+        data — re-importing an older file cannot weaken the stored
+        attestation lower bounds."""
+        def interchange(src, tgt):
+            return {
+                "metadata": {
+                    "interchange_format_version": "5",
+                    "genesis_validators_root": "0x" + GVR.hex(),
+                },
+                "data": [{
+                    "pubkey": "0x" + PK.hex(),
+                    "signed_blocks": [],
+                    "signed_attestations": [{
+                        "source_epoch": str(src),
+                        "target_epoch": str(tgt),
+                        "signing_root": "0x" + (b"\x0a" * 32).hex(),
+                    }],
+                }],
+            }
+
+        sp = SlashingProtection()
+        sp.import_interchange(interchange(5, 6), GVR)
+        sp.import_interchange(interchange(1, 2), GVR)  # older: must not lower
+        # below the (5, 6) bounds -> still refused
+        with pytest.raises(SlashingProtectionError):
+            sp.check_and_insert_attestation(
+                PK, SignedAttestationRecord(4, 6, b"\x0b" * 32)
+            )
+        with pytest.raises(SlashingProtectionError):
+            sp.check_and_insert_attestation(
+                PK, SignedAttestationRecord(5, 6, b"\x0c" * 32)
+            )
+        # above them -> accepted
+        sp.check_and_insert_attestation(PK, SignedAttestationRecord(5, 7, b"\x0d" * 32))
