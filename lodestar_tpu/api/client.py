@@ -69,7 +69,7 @@ class ApiClient:
 
     async def publish_block(self, signed_block) -> None:
         await self._post(
-            "/eth/v1/beacon/blocks", to_json(ssz.phase0.SignedBeaconBlock, signed_block)
+            "/eth/v1/beacon/blocks", to_json(type(signed_block), signed_block)
         )
 
     async def submit_pool_attestations(self, atts) -> None:
@@ -125,4 +125,27 @@ class ApiClient:
         await self._post(
             "/eth/v1/validator/aggregate_and_proofs",
             [to_json(ssz.phase0.SignedAggregateAndProof, s) for s in signed_aggs],
+        )
+
+    async def get_liveness(self, epoch: int, indices):
+        """POST /eth/v1/validator/liveness/{epoch} (doppelganger source)."""
+        return (await self._post(f"/eth/v1/validator/liveness/{epoch}",
+                                 [str(i) for i in indices]))["data"]
+
+    async def submit_attester_slashing(self, slashing) -> None:
+        await self._post(
+            "/eth/v1/beacon/pool/attester_slashings",
+            to_json(ssz.phase0.AttesterSlashing, slashing),
+        )
+
+    async def submit_proposer_slashing(self, slashing) -> None:
+        await self._post(
+            "/eth/v1/beacon/pool/proposer_slashings",
+            to_json(ssz.phase0.ProposerSlashing, slashing),
+        )
+
+    async def submit_voluntary_exit(self, signed_exit) -> None:
+        await self._post(
+            "/eth/v1/beacon/pool/voluntary_exits",
+            to_json(ssz.phase0.SignedVoluntaryExit, signed_exit),
         )

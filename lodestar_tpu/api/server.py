@@ -41,6 +41,9 @@ class BeaconRestApiServer:
 
     def __init__(self, chain, db, network=None, sync=None, light_client_server=None):
         self.light_client_server = light_client_server
+        from lodestar_tpu.types import signed_block_wire_codec
+
+        signed_block_wire_codec.configure(chain.cfg)
         self.chain = chain
         self.db = db
         self.network = network
@@ -76,6 +79,13 @@ class BeaconRestApiServer:
         r.add_post("/eth/v1/beacon/blocks", self.post_block)
         r.add_post("/eth/v1/beacon/pool/attestations", self.post_pool_attestations)
         r.add_post("/eth/v1/beacon/pool/voluntary_exits", self.post_pool_exit)
+        r.add_post(
+            "/eth/v1/beacon/pool/attester_slashings", self.post_pool_attester_slashing
+        )
+        r.add_post(
+            "/eth/v1/beacon/pool/proposer_slashings", self.post_pool_proposer_slashing
+        )
+        r.add_post("/eth/v1/validator/liveness/{epoch}", self.post_liveness)
         # node
         r.add_get("/eth/v1/node/version", self.get_version)
         r.add_get("/eth/v1/node/health", self.get_health)
@@ -290,7 +300,12 @@ class BeaconRestApiServer:
 
     async def post_block(self, request):
         body = await request.json()
-        signed = from_json(ssz.phase0.SignedBeaconBlock, body)
+        # fork-aware: the JSON's message.slot picks the container type
+        from lodestar_tpu.types import signed_block_wire_codec, types_for
+
+        slot = int(body["message"]["slot"])
+        fork = signed_block_wire_codec.fork_at_slot(slot)
+        signed = from_json(types_for(fork)[2], body)
         try:
             await self.chain.process_block(signed)
         except ValueError as e:
@@ -506,6 +521,16 @@ class BeaconRestApiServer:
         prop_slash, att_slash, exits = self.chain.op_pool.get_slashings_and_exits(
             pre.state
         )
+        # eth1 data vote + due deposits (produceBlockBody.ts eth1 section)
+        eth1_tracker = getattr(self.chain, "eth1", None)
+        eth1_data = pre.state.eth1_data
+        deposits = []
+        if eth1_tracker is not None:
+            eth1_data = eth1_tracker.get_eth1_vote(pre.state)
+            # deposits must be counted/proven against the eth1_data the
+            # block CARRIES: process_eth1_data may flip state.eth1_data to
+            # this vote before process_operations checks deposit counts
+            deposits = eth1_tracker.get_deposits(pre.state, eth1_data)
         g = graffiti.encode()[:32].ljust(32, b"\x00") if isinstance(graffiti, str) else graffiti
         from lodestar_tpu.types import fork_of_state, types_for
 
@@ -513,11 +538,12 @@ class BeaconRestApiServer:
         _, block_t, signed_t, body_t = types_for(fork)
         body = body_t(
             randao_reveal=randao_reveal,
-            eth1_data=pre.state.eth1_data,
+            eth1_data=eth1_data,
             graffiti=g,
             proposer_slashings=prop_slash,
             attester_slashings=att_slash,
             attestations=atts,
+            deposits=deposits,
             voluntary_exits=exits,
         )
         if hasattr(body, "sync_aggregate"):
@@ -730,3 +756,67 @@ class BeaconRestApiServer:
         if u is None:
             return _err(404, "no optimistic update yet")
         return _ok(to_json(ssz.altair.LightClientOptimisticUpdate, u))
+
+
+    # ------------------------------------------------------------------
+    # slashing pools + liveness (flare/doppelganger support)
+    # ------------------------------------------------------------------
+
+    async def post_pool_attester_slashing(self, request):
+        body = await request.json()
+        s = from_json(ssz.phase0.AttesterSlashing, body)
+        from lodestar_tpu.state_transition.block.phase0 import (
+            is_slashable_attestation_data,
+            is_valid_indexed_attestation,
+        )
+
+        st = self.chain.get_head_state()
+        if not is_slashable_attestation_data(s.attestation_1.data, s.attestation_2.data):
+            return _err(400, "attestations are not slashable")
+        for att in (s.attestation_1, s.attestation_2):
+            if not is_valid_indexed_attestation(
+                self.chain.cfg, st.state, att, verify_signature=True
+            ):
+                return _err(400, "invalid indexed attestation")
+        self.chain.op_pool.add_attester_slashing(s)
+        return _ok(None)
+
+    async def post_pool_proposer_slashing(self, request):
+        body = await request.json()
+        s = from_json(ssz.phase0.ProposerSlashing, body)
+        from lodestar_tpu.state_transition.signature_sets import (
+            get_proposer_slashing_signature_sets,
+        )
+        from lodestar_tpu.crypto.bls import api as _bls
+
+        st = self.chain.get_head_state()
+        h1, h2 = s.signed_header_1.message, s.signed_header_2.message
+        if h1.slot != h2.slot or h1.proposer_index != h2.proposer_index:
+            return _err(400, "headers not slashable")
+        if ssz.phase0.BeaconBlockHeader.serialize(h1) == ssz.phase0.BeaconBlockHeader.serialize(h2):
+            return _err(400, "identical headers")
+        for sig_set in get_proposer_slashing_signature_sets(
+            self.chain.cfg, st.state, s
+        ):
+            if not _bls.verify_signature_set(sig_set):
+                return _err(400, "invalid header signature")
+        self.chain.op_pool.add_proposer_slashing(s)
+        return _ok(None)
+
+    async def post_liveness(self, request):
+        """Validator liveness per epoch from the seen-attester cache
+        (validator/liveness route, the doppelganger data source)."""
+        epoch = int(request.match_info["epoch"])
+        indices = [int(i) for i in await request.json()]
+        return _ok(
+            [
+                {
+                    "index": str(i),
+                    "is_live": self.chain.seen_attesters.is_known(epoch, i)
+                    or self.chain.seen_block_proposers.is_known_proposer_in_epoch(
+                        epoch, i
+                    ),
+                }
+                for i in indices
+            ]
+        )

@@ -131,11 +131,13 @@ class BeaconChain:
         execution_engine=None,
         clock: Optional[LocalClock] = None,
         metrics=None,
+        eth1=None,
     ):
         self.cfg = cfg
         self.db = db
         self.bls = verifier or SingleThreadBlsVerifier()
         self.execution_engine = execution_engine
+        self.eth1 = eth1  # Eth1DepositDataTracker or None
         self.metrics = metrics  # lodestar_tpu.metrics.Metrics or None
         anchor = CachedBeaconState(cfg, anchor_state)
         self.genesis_time = anchor_state.genesis_time

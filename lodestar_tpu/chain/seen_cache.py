@@ -41,6 +41,15 @@ class SeenBlockProposers:
     def add(self, slot: int, proposer: int) -> None:
         self._by_slot.setdefault(slot, set()).add(proposer)
 
+    def is_known_proposer_in_epoch(self, epoch: int, proposer: int) -> bool:
+        from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+        start = epoch * _p.SLOTS_PER_EPOCH
+        return any(
+            proposer in self._by_slot.get(s, ())
+            for s in range(start, start + _p.SLOTS_PER_EPOCH)
+        )
+
     def prune(self, finalized_slot: int) -> None:
         for s in [s for s in self._by_slot if s <= finalized_slot]:
             del self._by_slot[s]

@@ -78,6 +78,36 @@ def build_parser() -> argparse.ArgumentParser:
                     help="trusted block root hex (default: the node's finalized root)")
     lc.add_argument("--updates", type=int, default=4,
                     help="stop after N processed updates")
+
+    # validator ops subcommands (cmds/validator/{voluntaryExit,
+    # slashingProtection}) — separate top-level verbs for argparse clarity
+    vexit = sub.add_parser("validator-exit", help="sign + submit a voluntary exit")
+    vexit.add_argument("--beacon-url", type=str, default="http://127.0.0.1:9596")
+    vexit.add_argument("--index", type=int, required=True, help="validator index (interop key)")
+    vexit.add_argument("--epoch", type=int, default=None, help="exit epoch (default: current)")
+
+    sp_exp = sub.add_parser(
+        "slashing-protection-export", help="write the EIP-3076 interchange file"
+    )
+    sp_exp.add_argument("--db", type=str, required=True, help="slashing protection sqlite db FILE path")
+    sp_exp.add_argument("--file", type=str, required=True)
+    sp_exp.add_argument("--genesis-validators-root", type=str, required=True)
+    sp_exp.add_argument("--pubkeys", type=str, default="", help="comma-separated hex pubkeys")
+
+    sp_imp = sub.add_parser(
+        "slashing-protection-import", help="merge an EIP-3076 interchange file"
+    )
+    sp_imp.add_argument("--db", type=str, required=True)
+    sp_imp.add_argument("--file", type=str, required=True)
+    sp_imp.add_argument("--genesis-validators-root", type=str, required=True)
+
+    flare = sub.add_parser(
+        "flare", help="ops/debug tooling: craft self-slashings for OWNED devnet keys"
+    )
+    flare.add_argument("action", choices=["self-slash-attester", "self-slash-proposer"])
+    flare.add_argument("--beacon-url", type=str, default="http://127.0.0.1:9596")
+    flare.add_argument("--index", type=int, required=True, help="interop validator index")
+    flare.add_argument("--epoch", type=int, default=0)
     return parser
 
 
@@ -348,6 +378,96 @@ def run_lightclient(args) -> int:
     return 0
 
 
+def run_validator_exit(args) -> int:
+    import asyncio
+
+    from lodestar_tpu.api.client import ApiClient
+    from lodestar_tpu.config import ForkConfig, default_chain_config as cfg
+    from lodestar_tpu.state_transition.util.interop import interop_secret_keys
+    from lodestar_tpu.validator.validator_store import ValidatorStore
+
+    async def run():
+        api = ApiClient(args.beacon_url)
+        genesis = await api.get_genesis()
+        gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+        sk = interop_secret_keys(args.index + 1)[args.index]
+        store = ValidatorStore([sk], ForkConfig(cfg), gvr)
+        if args.epoch is not None:
+            epoch = args.epoch
+        else:
+            from lodestar_tpu.params import SLOTS_PER_EPOCH
+
+            genesis_time = int(genesis["genesis_time"])
+            epoch = max(
+                0, int((time.time() - genesis_time) / cfg.SECONDS_PER_SLOT)
+            ) // SLOTS_PER_EPOCH
+        signed = store.sign_voluntary_exit(
+            sk.to_public_key().to_bytes(), args.index, epoch
+        )
+        await api.submit_voluntary_exit(signed)
+        await api.close()
+        print(json.dumps({"submitted_exit": args.index, "epoch": epoch}))
+
+    asyncio.run(run())
+    return 0
+
+
+def run_slashing_protection(args, export: bool) -> int:
+    from lodestar_tpu.db.controller import SqliteController
+    from lodestar_tpu.validator.slashing_protection import SlashingProtection
+
+    gvr = bytes.fromhex(args.genesis_validators_root.replace("0x", ""))
+    sp = SlashingProtection(SqliteController(args.db))
+    if export:
+        pubkeys = [
+            bytes.fromhex(p.replace("0x", ""))
+            for p in args.pubkeys.split(",")
+            if p
+        ]
+        obj = sp.export_interchange(gvr, pubkeys)
+        with open(args.file, "w") as f:
+            json.dump(obj, f, indent=2)
+        print(f"exported {len(pubkeys)} keys -> {args.file}")
+    else:
+        with open(args.file) as f:
+            sp.import_interchange(json.load(f), gvr)
+        print(f"imported interchange from {args.file}")
+    return 0
+
+
+def run_flare(args) -> int:
+    import asyncio
+
+    from lodestar_tpu.api.client import ApiClient
+    from lodestar_tpu.config import default_chain_config as cfg
+    from lodestar_tpu.flare import (
+        make_self_attester_slashing,
+        make_self_proposer_slashing,
+    )
+    from lodestar_tpu.state_transition.util.interop import interop_secret_keys
+
+    async def run():
+        api = ApiClient(args.beacon_url)
+        genesis = await api.get_genesis()
+        gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+        sk = interop_secret_keys(args.index + 1)[args.index]
+        if args.action == "self-slash-attester":
+            s = make_self_attester_slashing(cfg, gvr, sk, args.index, args.epoch)
+            await api.submit_attester_slashing(s)
+        else:
+            from lodestar_tpu.params import SLOTS_PER_EPOCH
+
+            s = make_self_proposer_slashing(
+                cfg, gvr, sk, args.index, args.epoch * SLOTS_PER_EPOCH + 1
+            )
+            await api.submit_proposer_slashing(s)
+        await api.close()
+        print(json.dumps({"submitted": args.action, "index": args.index}))
+
+    asyncio.run(run())
+    return 0
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -367,6 +487,14 @@ def main(argv=None) -> int:
         return run_validator(args)
     if args.command == "lightclient":
         return run_lightclient(args)
+    if args.command == "validator-exit":
+        return run_validator_exit(args)
+    if args.command == "slashing-protection-export":
+        return run_slashing_protection(args, export=True)
+    if args.command == "slashing-protection-import":
+        return run_slashing_protection(args, export=False)
+    if args.command == "flare":
+        return run_flare(args)
     parser.print_help()
     return 1
 

@@ -1,0 +1,221 @@
+"""Eth1 deposit tracking + eth1 data voting (reference:
+packages/beacon-node/src/eth1/ — eth1DepositDataTracker.ts,
+eth1DataCache.ts, provider/).
+
+The tracker follows an eth1 provider (JSON-RPC in production; the mock
+here plays the engine/mock.ts role), ingests DepositEvent logs into the
+deposit cache (db.deposit_event + db.deposit_data_root), and serves
+block production with:
+
+- the eth1 data VOTE (spec get_eth1_vote: the majority vote within the
+  current voting period, else the follow-distance block), and
+- the DEPOSITS due for inclusion (proofs against the state's
+  eth1_data.deposit_root from the incremental deposit tree).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, Tuple
+
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+)
+from lodestar_tpu.state_transition.util.merkle import (
+    list_single_proof,
+    list_tree_root,
+)
+from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+from lodestar_tpu.types import ssz
+
+
+@dataclass(frozen=True)
+class Eth1Block:
+    number: int
+    hash: bytes
+    timestamp: int
+
+
+class Eth1Provider(Protocol):
+    """The JSON-RPC seam (provider/eth1Provider.ts)."""
+
+    async def get_block_number(self) -> int: ...
+    async def get_block(self, number: int) -> Optional[Eth1Block]: ...
+    async def get_deposit_events(
+        self, from_block: int, to_block: int
+    ) -> List["ssz.phase0.DepositEvent"]: ...
+
+
+class MockEth1Provider:
+    """In-memory eth1 chain with scripted deposits (the test/sim EL)."""
+
+    def __init__(self, genesis_timestamp: int = 0, block_time: int = 14):
+        self.blocks: List[Eth1Block] = []
+        self.deposits_by_block: Dict[int, List["ssz.phase0.DepositEvent"]] = {}
+        self.block_time = block_time
+        self.genesis_timestamp = genesis_timestamp
+        self.add_blocks(1)
+
+    def add_blocks(self, n: int) -> None:
+        for _ in range(n):
+            num = len(self.blocks)
+            self.blocks.append(
+                Eth1Block(
+                    number=num,
+                    hash=num.to_bytes(4, "big").rjust(32, b"\xe1"),
+                    timestamp=self.genesis_timestamp + num * self.block_time,
+                )
+            )
+
+    def add_deposit(self, deposit_data: "ssz.phase0.DepositData") -> None:
+        """Include a deposit log in the latest block."""
+        num = len(self.blocks) - 1
+        index = sum(len(v) for v in self.deposits_by_block.values())
+        ev = ssz.phase0.DepositEvent(
+            deposit_data=deposit_data, block_number=num, index=index
+        )
+        self.deposits_by_block.setdefault(num, []).append(ev)
+
+    async def get_block_number(self) -> int:
+        return len(self.blocks) - 1
+
+    async def get_block(self, number: int) -> Optional[Eth1Block]:
+        if 0 <= number < len(self.blocks):
+            return self.blocks[number]
+        return None
+
+    async def get_deposit_events(self, from_block: int, to_block: int):
+        out = []
+        for n in range(from_block, to_block + 1):
+            out.extend(self.deposits_by_block.get(n, []))
+        return out
+
+
+class DepositTree:
+    """Incremental deposit merkle tree (the deposit contract's tree;
+    persistent-merkle-tree role for deposit proofs)."""
+
+    def __init__(self):
+        self.roots: List[bytes] = []  # DepositData hash tree roots, by index
+
+    def push(self, deposit_data: "ssz.phase0.DepositData") -> None:
+        self.roots.append(ssz.phase0.DepositData.hash_tree_root(deposit_data))
+
+    def count(self) -> int:
+        return len(self.roots)
+
+    def root_at(self, count: int) -> bytes:
+        return list_tree_root(
+            self.roots[:count], DEPOSIT_CONTRACT_TREE_DEPTH, count
+        )
+
+    def proof(self, index: int, count: int) -> List[bytes]:
+        return list_single_proof(
+            self.roots[:count], DEPOSIT_CONTRACT_TREE_DEPTH, index, count
+        )
+
+
+class Eth1DepositDataTracker:
+    def __init__(self, provider: Eth1Provider, cfg, db=None):
+        self.provider = provider
+        self.cfg = cfg
+        self.db = db
+        self.tree = DepositTree()
+        self.deposit_events: List["ssz.phase0.DepositEvent"] = []
+        self.block_cache: List[Eth1Block] = []
+        self._synced_to = -1
+
+    # -- ingestion ------------------------------------------------------
+
+    async def update(self) -> int:
+        """Pull new blocks + deposit logs from the provider; returns the
+        number of new deposit events ingested."""
+        head = await self.provider.get_block_number()
+        if head <= self._synced_to:
+            return 0
+        events = await self.provider.get_deposit_events(self._synced_to + 1, head)
+        for ev in events:
+            assert ev.index == self.tree.count(), "deposit log gap"
+            self.tree.push(ev.deposit_data)
+            self.deposit_events.append(ev)
+            if self.db is not None:
+                self.db.deposit_event.put(ev.index, ev)
+                self.db.deposit_data_root.put(
+                    ev.index,
+                    ssz.phase0.DepositData.hash_tree_root(ev.deposit_data),
+                )
+        for n in range(self._synced_to + 1, head + 1):
+            blk = await self.provider.get_block(n)
+            if blk is not None:
+                self.block_cache.append(blk)
+        self._synced_to = head
+        return len(events)
+
+    # -- eth1 data voting (spec get_eth1_vote) --------------------------
+
+    def _voting_period_start_time(self, state) -> int:
+        period_start_slot = state.slot - state.slot % (
+            _p.EPOCHS_PER_ETH1_VOTING_PERIOD * _p.SLOTS_PER_EPOCH
+        )
+        return state.genesis_time + period_start_slot * self.cfg.SECONDS_PER_SLOT
+
+    def _is_candidate(self, block: Eth1Block, period_start: int) -> bool:
+        f = self.cfg.ETH1_FOLLOW_DISTANCE * self.cfg.SECONDS_PER_ETH1_BLOCK
+        return (
+            block.timestamp + f <= period_start
+            and block.timestamp + 2 * f >= period_start
+        )
+
+    def _eth1_data_for_block(self, block: Eth1Block) -> "ssz.phase0.Eth1Data":
+        count = sum(
+            1 for ev in self.deposit_events if ev.block_number <= block.number
+        )
+        return ssz.phase0.Eth1Data(
+            deposit_root=self.tree.root_at(count),
+            deposit_count=count,
+            block_hash=block.hash,
+        )
+
+    def get_eth1_vote(self, state) -> "ssz.phase0.Eth1Data":
+        period_start = self._voting_period_start_time(state)
+        candidates = [
+            b for b in self.block_cache if self._is_candidate(b, period_start)
+        ]
+        # only blocks whose deposit count has not regressed
+        valid = [
+            self._eth1_data_for_block(b)
+            for b in candidates
+        ]
+        valid = [d for d in valid if d.deposit_count >= state.eth1_data.deposit_count]
+        if not valid:
+            return state.eth1_data
+        # majority among the state's existing votes, else the latest candidate
+        def key(d):
+            return (bytes(d.deposit_root), d.deposit_count, bytes(d.block_hash))
+
+        votes: Dict[tuple, int] = {}
+        for v in state.eth1_data_votes:
+            votes[key(v)] = votes.get(key(v), 0) + 1
+        best = max(valid, key=lambda d: (votes.get(key(d), 0), d.deposit_count))
+        return best
+
+    # -- deposit inclusion (getDeposits) --------------------------------
+
+    def get_deposits(self, state, eth1_data=None) -> List["ssz.phase0.Deposit"]:
+        """Deposits due under `eth1_data` (default: the state's), proven
+        against its deposit root."""
+        data = eth1_data if eth1_data is not None else state.eth1_data
+        start = state.eth1_deposit_index
+        count = min(
+            _p.MAX_DEPOSITS, data.deposit_count - start
+        )
+        out = []
+        for i in range(start, start + count):
+            proof = self.tree.proof(i, data.deposit_count)
+            out.append(
+                ssz.phase0.Deposit(
+                    proof=proof,
+                    data=self.deposit_events[i].deposit_data,
+                )
+            )
+        return out

@@ -1,0 +1,95 @@
+"""Ops/debug tooling (reference: packages/flare — cli.ts +
+cmds/selfSlash{Attester,Proposer}.ts).
+
+Crafts provably-slashable messages for OWNED keys (devnet testing of the
+slashing pipeline): a double-vote attester slashing or a double-proposal
+proposer slashing, signed with the real domains so the beacon node's
+pool validation accepts them.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+)
+from lodestar_tpu.state_transition.util.domain import (
+    compute_domain,
+    compute_signing_root,
+)
+from lodestar_tpu.types import ssz
+
+
+def _fork_version(cfg, epoch: int) -> bytes:
+    from lodestar_tpu.config import ForkConfig
+
+    return ForkConfig(cfg).fork_version_at_epoch(epoch)
+
+
+def make_self_attester_slashing(
+    cfg,
+    genesis_validators_root: bytes,
+    sk: "bls.SecretKey",
+    validator_index: int,
+    target_epoch: int,
+) -> "ssz.phase0.AttesterSlashing":
+    """Two attestations, same target epoch, different beacon roots — a
+    DOUBLE VOTE (selfSlashAttester.ts)."""
+    domain = compute_domain(
+        DOMAIN_BEACON_ATTESTER,
+        _fork_version(cfg, target_epoch),
+        genesis_validators_root,
+    )
+
+    def make(att_root: bytes) -> "ssz.phase0.IndexedAttestation":
+        data = ssz.phase0.AttestationData(
+            slot=target_epoch * _p.SLOTS_PER_EPOCH,
+            index=0,
+            beacon_block_root=att_root,
+            source=ssz.phase0.Checkpoint(epoch=max(0, target_epoch - 1), root=b"\x00" * 32),
+            target=ssz.phase0.Checkpoint(epoch=target_epoch, root=att_root),
+        )
+        root = compute_signing_root(ssz.phase0.AttestationData, data, domain)
+        return ssz.phase0.IndexedAttestation(
+            attesting_indices=[validator_index],
+            data=data,
+            signature=sk.sign(root).to_bytes(),
+        )
+
+    return ssz.phase0.AttesterSlashing(
+        attestation_1=make(b"\x01" * 32), attestation_2=make(b"\x02" * 32)
+    )
+
+
+def make_self_proposer_slashing(
+    cfg,
+    genesis_validators_root: bytes,
+    sk: "bls.SecretKey",
+    validator_index: int,
+    slot: int,
+) -> "ssz.phase0.ProposerSlashing":
+    """Two signed headers at the same slot (selfSlashProposer.ts)."""
+    epoch = slot // _p.SLOTS_PER_EPOCH
+    domain = compute_domain(
+        DOMAIN_BEACON_PROPOSER, _fork_version(cfg, epoch), genesis_validators_root
+    )
+
+    def make(body_root: bytes) -> "ssz.phase0.SignedBeaconBlockHeader":
+        hdr = ssz.phase0.BeaconBlockHeader(
+            slot=slot,
+            proposer_index=validator_index,
+            parent_root=b"\x00" * 32,
+            state_root=b"\x00" * 32,
+            body_root=body_root,
+        )
+        root = compute_signing_root(ssz.phase0.BeaconBlockHeader, hdr, domain)
+        return ssz.phase0.SignedBeaconBlockHeader(
+            message=hdr, signature=sk.sign(root).to_bytes()
+        )
+
+    return ssz.phase0.ProposerSlashing(
+        signed_header_1=make(b"\x0a" * 32), signed_header_2=make(b"\x0b" * 32)
+    )

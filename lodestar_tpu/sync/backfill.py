@@ -1,0 +1,145 @@
+"""Backfill sync (reference: beacon-node/src/sync/backfill/backfill.ts +
+verify.ts:43).
+
+After a weak-subjectivity (checkpoint) start the node has no history
+below its anchor.  BackfillSync walks BACKWARD from the anchor block:
+batches of older blocks are fetched by range, hash-chain linked
+(child.parent_root == root(parent)), and only PROPOSER signatures are
+verified — batched through the pluggable BLS verifier — before the
+blocks land in the by-slot block archive.  Full state-transition replay
+is never needed for finalized history.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import ACTIVE_PRESET as _p, DOMAIN_BEACON_PROPOSER
+from lodestar_tpu.state_transition.util.domain import (
+    compute_domain,
+    compute_signing_root,
+)
+from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+from lodestar_tpu.types import ssz
+
+
+class BackfillError(ValueError):
+    pass
+
+
+@dataclass
+class BackfillResult:
+    archived: int
+    oldest_slot: Optional[int]
+    complete: bool  # reached slot 0 / genesis
+
+
+class BackfillSync:
+    def __init__(self, chain, network, batch_slots: Optional[int] = None):
+        self.chain = chain
+        self.network = network
+        self.batch_slots = batch_slots or _p.SLOTS_PER_EPOCH
+        # the backward frontier: we need the block whose ROOT equals this
+        anchor = chain.db.block.get(chain.anchor_root)
+        self.expected_root: bytes = (
+            bytes(anchor.message.parent_root) if anchor else b"\x00" * 32
+        )
+        self.next_slot_hint: int = anchor.message.slot - 1 if anchor else 0
+
+    # ------------------------------------------------------------------
+
+    def _proposer_pubkey(self, proposer_index: int) -> bls.PublicKey:
+        st = self.chain.get_head_state().state
+        return bls.PublicKey.from_bytes(bytes(st.validators[proposer_index].pubkey))
+
+    def _proposer_signature_set(self, signed_block) -> bls.SignatureSet:
+        """Proposer sig over the block root with the proposer domain of the
+        block's epoch (backfill/verify.ts verifyBlockProposerSignature)."""
+        st = self.chain.get_head_state().state
+        block = signed_block.message
+        from lodestar_tpu.config import ForkConfig
+
+        epoch = compute_epoch_at_slot(block.slot)
+        fork_version = ForkConfig(self.chain.cfg).fork_version_at_epoch(epoch)
+        domain = compute_domain(
+            DOMAIN_BEACON_PROPOSER,
+            fork_version,
+            self.chain.genesis_validators_root,
+        )
+        root = compute_signing_root(type(block), block, domain)
+        return bls.SignatureSet(
+            self._proposer_pubkey(block.proposer_index),
+            root,
+            bls.Signature.from_bytes(bytes(signed_block.signature)),
+        )
+
+    async def _verify_batch(self, blocks: List) -> None:
+        """Hash-chain linkage backward + batched proposer signatures."""
+        expected = self.expected_root
+        for signed in reversed(blocks):  # newest -> oldest
+            msg = signed.message
+            root = type(msg).hash_tree_root(msg)
+            if root != expected:
+                raise BackfillError(
+                    f"chain break at slot {msg.slot}: {root.hex()[:16]} != "
+                    f"{expected.hex()[:16]}"
+                )
+            expected = bytes(msg.parent_root)
+        try:
+            sets = [
+                self._proposer_signature_set(b)
+                for b in blocks
+                if b.message.slot > 0  # genesis placeholder has no signature
+            ]
+        except ValueError as e:  # malformed pubkey/signature encoding
+            raise BackfillError(f"malformed proposer signature: {e}")
+        if sets:
+            from lodestar_tpu.chain.bls import VerifyOptions
+
+            ok = await self.chain.bls.verify_signature_sets(
+                sets, VerifyOptions(batchable=True)
+            )
+            if not ok:
+                raise BackfillError("proposer signature batch invalid")
+
+    # ------------------------------------------------------------------
+
+    async def run(self, to_slot: int = 0) -> BackfillResult:
+        """Fill the archive backward until `to_slot` (or peers run dry)."""
+        archived = 0
+        oldest: Optional[int] = None
+        while self.next_slot_hint >= to_slot and self.expected_root != b"\x00" * 32:
+            start = max(to_slot, self.next_slot_hint - self.batch_slots + 1)
+            count = self.next_slot_hint - start + 1
+            blocks = await self._download(start, count)
+            if not blocks:
+                return BackfillResult(archived, oldest, complete=False)
+            await self._verify_batch(blocks)
+            for signed in blocks:
+                slot = signed.message.slot
+                self.chain.db.block_archive.put(slot, signed)
+                self.chain.db.block_archive_root_index.put(
+                    type(signed.message).hash_tree_root(signed.message), slot
+                )
+                oldest = slot if oldest is None else min(oldest, slot)
+                archived += 1
+            first = blocks[0].message
+            self.expected_root = bytes(first.parent_root)
+            self.next_slot_hint = first.slot - 1
+            if first.slot == 0:
+                break
+        self.chain.db.backfilled_ranges.put(
+            oldest if oldest is not None else 0, self.next_slot_hint + 1
+        )
+        return BackfillResult(archived, oldest, complete=True)
+
+    async def _download(self, start: int, count: int) -> Optional[List]:
+        for pid in self.network.peer_manager.connected_peers():
+            try:
+                blocks = await self.network.blocks_by_range(pid, start, count)
+                if blocks:
+                    return blocks
+            except Exception:
+                continue
+        return None

@@ -1,0 +1,74 @@
+"""Doppelganger protection (reference:
+packages/validator/src/services/doppelgangerService.ts:37).
+
+Before a validator client starts signing it watches the network for
+liveness of its own indices: any attestation or proposal by one of our
+validators during the observation window means ANOTHER instance is
+running with the same keys — signing would self-slash, so the service
+flags the key and the client must refuse duties for it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Set
+
+DEFAULT_REMAINING_EPOCHS = 2  # doppelgangerService.ts DEFAULT_REMAINING_DETECTION_EPOCHS
+
+
+class DoppelgangerStatus(str, Enum):
+    Unverified = "Unverified"      # still inside the observation window
+    VerifiedSafe = "VerifiedSafe"  # window passed with no liveness hits
+    DoppelgangerDetected = "DoppelgangerDetected"
+
+
+@dataclass
+class _Registration:
+    remaining_epochs: int
+    status: DoppelgangerStatus = DoppelgangerStatus.Unverified
+
+
+class DoppelgangerService:
+    def __init__(self, api, remaining_epochs: int = DEFAULT_REMAINING_EPOCHS):
+        self.api = api
+        self._default_epochs = remaining_epochs
+        self._by_index: Dict[int, _Registration] = {}
+
+    def register(self, index: int) -> None:
+        if index not in self._by_index:
+            self._by_index[index] = _Registration(self._default_epochs)
+
+    def status(self, index: int) -> DoppelgangerStatus:
+        reg = self._by_index.get(index)
+        return reg.status if reg else DoppelgangerStatus.VerifiedSafe
+
+    def is_safe(self, index: int) -> bool:
+        return self.status(index) == DoppelgangerStatus.VerifiedSafe
+
+    def detected(self) -> List[int]:
+        return [
+            i
+            for i, r in self._by_index.items()
+            if r.status == DoppelgangerStatus.DoppelgangerDetected
+        ]
+
+    async def check_epoch(self, epoch: int) -> None:
+        """Run once per epoch during the observation window: query the
+        node's liveness view of the PREVIOUS epoch for unverified keys."""
+        pending = [
+            i
+            for i, r in self._by_index.items()
+            if r.status == DoppelgangerStatus.Unverified
+        ]
+        if not pending:
+            return
+        results = await self.api.get_liveness(max(0, epoch - 1), pending)
+        live = {int(r["index"]) for r in results if r["is_live"]}
+        for i in pending:
+            reg = self._by_index[i]
+            if i in live:
+                reg.status = DoppelgangerStatus.DoppelgangerDetected
+            else:
+                reg.remaining_epochs -= 1
+                if reg.remaining_epochs <= 0:
+                    reg.status = DoppelgangerStatus.VerifiedSafe

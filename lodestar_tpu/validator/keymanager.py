@@ -1,0 +1,110 @@
+"""Keymanager API server (reference: packages/api/src/keymanager/routes.ts
++ validator keymanager server in cmds/validator).
+
+Standard eth keymanager surface over the validator's key store:
+GET /eth/v1/keystores, POST /eth/v1/keystores (EIP-2335 import with
+slashing-protection data), DELETE /eth/v1/keystores (export slashing
+protection for the removed keys).
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from aiohttp import web
+
+from lodestar_tpu.crypto.bls import api as bls
+from .keystore import KeystoreError, decrypt_keystore
+from .slashing_protection import SlashingProtection
+
+
+class KeymanagerApiServer:
+    def __init__(
+        self,
+        store,
+        slashing_protection: SlashingProtection,
+        genesis_validators_root: bytes,
+        host: str = "127.0.0.1",
+        port: int = 5062,
+    ):
+        self.store = store
+        self.slashing_protection = slashing_protection
+        self.genesis_validators_root = genesis_validators_root
+        self.host = host
+        self.port = port
+        self._runner = None
+        self.app = web.Application()
+        r = self.app.router
+        r.add_get("/eth/v1/keystores", self.list_keystores)
+        r.add_post("/eth/v1/keystores", self.import_keystores)
+        r.add_delete("/eth/v1/keystores", self.delete_keystores)
+
+    # ------------------------------------------------------------------
+
+    async def list_keystores(self, request):
+        return web.json_response(
+            {
+                "data": [
+                    {"validating_pubkey": "0x" + pk.hex(), "readonly": False}
+                    for pk in self.store.pubkeys
+                ]
+            }
+        )
+
+    async def import_keystores(self, request):
+        body = await request.json()
+        keystores = body.get("keystores", [])
+        passwords = body.get("passwords", [])
+        interchange = body.get("slashing_protection")
+        if interchange:
+            self.slashing_protection.import_interchange(
+                json.loads(interchange)
+                if isinstance(interchange, str)
+                else interchange,
+                self.genesis_validators_root,
+            )
+        statuses = []
+        for ks, pw in zip(keystores, passwords):
+            try:
+                ks_obj = json.loads(ks) if isinstance(ks, str) else ks
+                secret = decrypt_keystore(ks_obj, pw)
+                sk = bls.SecretKey.from_bytes(secret)
+                pk = sk.to_public_key().to_bytes()
+                if self.store.has(pk):
+                    statuses.append({"status": "duplicate"})
+                else:
+                    self.store.add(sk)
+                    statuses.append({"status": "imported"})
+            except (KeystoreError, ValueError) as e:
+                statuses.append({"status": "error", "message": str(e)})
+        return web.json_response({"data": statuses})
+
+    async def delete_keystores(self, request):
+        body = await request.json()
+        pubkeys = [bytes.fromhex(p.replace("0x", "")) for p in body.get("pubkeys", [])]
+        statuses = []
+        for pk in pubkeys:
+            if self.store.has(pk):
+                self.store.remove(pk)
+                statuses.append({"status": "deleted"})
+            else:
+                statuses.append({"status": "not_found"})
+        interchange = self.slashing_protection.export_interchange(
+            self.genesis_validators_root, pubkeys
+        )
+        return web.json_response(
+            {"data": statuses, "slashing_protection": json.dumps(interchange)}
+        )
+
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

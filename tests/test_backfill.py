@@ -1,0 +1,129 @@
+"""Backfill sync: a checkpoint-synced node fills history backward from
+its anchor, hash-chain linking and verifying only proposer signatures
+(reference: sync/backfill/backfill.ts + verify.ts:43).
+"""
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.config import minimal_chain_config as cfg
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.network import InProcessHub, Network
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ACTIVE_PRESET_NAME
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.sync.backfill import BackfillError, BackfillSync
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+E = _p.SLOTS_PER_EPOCH
+
+
+class FakeTime:
+    def __init__(self, t0=0.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+
+def test_backfill_from_checkpoint_anchor():
+    async def go():
+        hub = InProcessHub()
+        ft = FakeTime()
+
+        # node A: the full-history peer
+        dev = DevChain(cfg, 8, genesis_time=0)
+        _, anchor_a = init_dev_state(cfg, 8, genesis_time=0)
+        chain_a = BeaconChain(
+            cfg, BeaconDb(), anchor_a,
+            clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=ft),
+        )
+        net_a = Network(hub, chain_a, chain_a.db)
+        anchor_slot = 2 * E
+        checkpoint_state = None
+        for slot in range(1, anchor_slot + 1):
+            ft.t = slot * cfg.SECONDS_PER_SLOT
+            if slot > 1:
+                dev.attest(slot - 1)
+            block = dev.produce_block(slot)
+            imported = dev.import_block(block, verify_signatures=False)
+            await chain_a.process_block(block)
+            if slot == anchor_slot:
+                checkpoint_state = imported.post_state.state
+
+        # node B: weak-subjectivity start from A's slot-2E post-state
+        chain_b = BeaconChain(
+            cfg, BeaconDb(), checkpoint_state,
+            clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=ft),
+        )
+        net_b = Network(hub, chain_b, chain_b.db)
+        await net_b.connect(net_a.peer_id)
+
+        bf = BackfillSync(chain_b, net_b)
+        result = await bf.run(to_slot=0)
+        assert result.complete
+        assert result.archived >= anchor_slot  # slots 0..2E-1 (incl. genesis)
+        # the archive holds a linked chain below the anchor
+        prev_root = None
+        for slot in range(1, anchor_slot):
+            blk = chain_b.db.block_archive.get(slot)
+            assert blk is not None, f"slot {slot} missing from archive"
+            if prev_root is not None:
+                assert bytes(blk.message.parent_root) == prev_root
+            prev_root = type(blk.message).hash_tree_root(blk.message)
+
+    asyncio.run(go())
+
+
+def test_backfill_rejects_corrupt_proposer_signature():
+    async def go():
+        ft = FakeTime()
+        dev = DevChain(cfg, 8, genesis_time=0)
+        _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+        chain = BeaconChain(
+            cfg, BeaconDb(), anchor,
+            clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=ft),
+        )
+        blocks = []
+        for slot in (1, 2, 3):
+            ft.t = slot * cfg.SECONDS_PER_SLOT
+            block = dev.produce_block(slot)
+            dev.import_block(block, verify_signatures=False)
+            await chain.process_block(block)
+            blocks.append(block)
+
+        class _NoNet:
+            pass
+
+        bf = BackfillSync.__new__(BackfillSync)
+        bf.chain = chain
+        bf.network = _NoNet()
+        bf.batch_slots = E
+        bf.expected_root = type(blocks[-1].message).hash_tree_root(blocks[-1].message)
+        bf.next_slot_hint = 3
+
+        # the honest batch verifies
+        await bf._verify_batch(blocks)
+
+        # corrupt a proposer signature -> batch must be rejected
+        from lodestar_tpu.types import ssz
+
+        bad = ssz.phase0.SignedBeaconBlock.deserialize(
+            ssz.phase0.SignedBeaconBlock.serialize(blocks[1])
+        )
+        sig = bytearray(bytes(bad.signature))
+        sig[7] ^= 0x20
+        bad.signature = bytes(sig)
+        with pytest.raises(BackfillError):
+            await bf._verify_batch([blocks[0], bad, blocks[2]])
+
+        # break the hash chain -> rejected before signatures
+        with pytest.raises(BackfillError, match="chain break"):
+            await bf._verify_batch([blocks[0], blocks[2]])
+
+    asyncio.run(go())

@@ -65,8 +65,11 @@ class TestBeaconValidatorProcesses:
             up = False
             while time.time() < deadline:
                 try:
-                    _get(f"http://127.0.0.1:{rest}/eth/v1/node/health")
-                    up = True
+                    # health returns 200 with an EMPTY body per the spec
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{rest}/eth/v1/node/health", timeout=5
+                    ):
+                        up = True
                     break
                 except Exception:
                     if beacon.poll() is not None:

@@ -1,0 +1,142 @@
+"""Eth1 deposit tracking: deposit tree proofs, tracker ingestion, eth1
+data voting, and full deposit inclusion through the state transition
+(reference: beacon-node/src/eth1/ + eth1DepositDataTracker.ts).
+"""
+import asyncio
+import hashlib
+
+import pytest
+
+from lodestar_tpu.config import minimal_chain_config as cfg
+from lodestar_tpu.eth1 import (
+    DepositTree,
+    Eth1DepositDataTracker,
+    MockEth1Provider,
+)
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    ACTIVE_PRESET_NAME,
+    BLS_WITHDRAWAL_PREFIX,
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    DOMAIN_DEPOSIT,
+)
+from lodestar_tpu.state_transition import CachedBeaconState
+from lodestar_tpu.state_transition.util.domain import (
+    ZERO_HASH,
+    compute_domain,
+    compute_signing_root,
+)
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.state_transition.util.interop import interop_secret_keys
+from lodestar_tpu.state_transition.util.merkle import is_valid_merkle_branch
+from lodestar_tpu.types import ssz
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+
+def make_deposit_data(index: int) -> "ssz.phase0.DepositData":
+    sk = interop_secret_keys(index + 1)[index]
+    pubkey = sk.to_public_key().to_bytes()
+    wc = bytearray(hashlib.sha256(pubkey).digest())
+    wc[0] = BLS_WITHDRAWAL_PREFIX
+    data = ssz.phase0.DepositData(
+        pubkey=pubkey,
+        withdrawal_credentials=bytes(wc),
+        amount=_p.MAX_EFFECTIVE_BALANCE,
+        signature=b"\x00" * 96,
+    )
+    dm = ssz.phase0.DepositMessage(
+        pubkey=pubkey, withdrawal_credentials=bytes(wc), amount=data.amount
+    )
+    domain = compute_domain(DOMAIN_DEPOSIT, cfg.GENESIS_FORK_VERSION, ZERO_HASH)
+    data.signature = sk.sign(
+        compute_signing_root(ssz.phase0.DepositMessage, dm, domain)
+    ).to_bytes()
+    return data
+
+
+class TestDepositTree:
+    def test_proofs_verify_at_every_count(self):
+        tree = DepositTree()
+        datas = [make_deposit_data(i) for i in range(4)]
+        for d in datas:
+            tree.push(d)
+        for count in range(1, 5):
+            root = tree.root_at(count)
+            for i in range(count):
+                proof = tree.proof(i, count)
+                leaf = ssz.phase0.DepositData.hash_tree_root(datas[i])
+                assert is_valid_merkle_branch(
+                    leaf, proof, DEPOSIT_CONTRACT_TREE_DEPTH + 1, i, root
+                ), (i, count)
+
+
+class TestTracker:
+    def _tracker_with_deposits(self, n_existing=8, n_new=2):
+        provider = MockEth1Provider()
+        tracker = Eth1DepositDataTracker(provider, cfg)
+        # replay the genesis validators' deposits, then the new ones
+        for i in range(n_existing + n_new):
+            provider.add_deposit(make_deposit_data(i))
+        provider.add_blocks(2)
+        asyncio.run(tracker.update())
+        return provider, tracker
+
+    def test_ingestion_and_counts(self):
+        provider, tracker = self._tracker_with_deposits()
+        assert tracker.tree.count() == 10
+        assert len(tracker.deposit_events) == 10
+
+    def test_deposit_inclusion_through_state_transition(self):
+        """A produced deposit with tracker proofs must process cleanly and
+        append the new validator (the full processDeposit path)."""
+        provider, tracker = self._tracker_with_deposits(n_existing=8, n_new=2)
+        _, state = init_dev_state(cfg, 8, genesis_time=0)
+        # the network voted in an eth1_data covering all 10 deposits
+        state.eth1_data = ssz.phase0.Eth1Data(
+            deposit_root=tracker.tree.root_at(10),
+            deposit_count=10,
+            block_hash=b"\xe1" * 32,
+        )
+        deposits = tracker.get_deposits(state)
+        assert len(deposits) == 2  # indices 8 and 9 are due
+        cached = CachedBeaconState(cfg, state)
+        from lodestar_tpu.state_transition.block.process_deposit import (
+            process_deposit,
+        )
+        from lodestar_tpu.params import ForkName
+
+        n_before = len(state.validators)
+        for dep in deposits:
+            process_deposit(
+                ForkName.phase0, cfg, state, dep, cached.epoch_ctx.pubkey2index
+            )
+        assert len(state.validators) == n_before + 2
+        assert state.eth1_deposit_index == 10
+
+    def test_eth1_vote_candidate_window(self):
+        provider = MockEth1Provider(genesis_timestamp=0, block_time=14)
+        tracker = Eth1DepositDataTracker(provider, cfg)
+        # the 8 genesis deposits land in block 0, then a long eth1 chain
+        # puts candidates inside the follow-distance window
+        for i in range(8):
+            provider.add_deposit(make_deposit_data(i))
+        provider.add_blocks(300)
+        asyncio.run(tracker.update())
+        _, state = init_dev_state(cfg, 8, genesis_time=0)
+        follow = cfg.ETH1_FOLLOW_DISTANCE * cfg.SECONDS_PER_ETH1_BLOCK
+        state.genesis_time = 300 * 14 + follow  # period start far past blocks
+        vote = tracker.get_eth1_vote(state)
+        # a candidate must exist, carrying the tracker's 8-deposit view
+        assert vote.deposit_count == 8
+        assert bytes(vote.block_hash).startswith(b"\xe1")
+
+    def test_vote_falls_back_to_state_data_without_candidates(self):
+        provider = MockEth1Provider()
+        tracker = Eth1DepositDataTracker(provider, cfg)
+        asyncio.run(tracker.update())
+        _, state = init_dev_state(cfg, 8, genesis_time=0)
+        vote = tracker.get_eth1_vote(state)
+        assert bytes(vote.block_hash) == bytes(state.eth1_data.block_hash)

@@ -1,0 +1,159 @@
+"""Ops tooling: flare self-slashings through the pool routes,
+doppelganger detection, and the keymanager API (reference:
+packages/flare, validator/services/doppelgangerService.ts,
+api/src/keymanager/routes.ts).
+"""
+import asyncio
+import json
+
+import pytest
+
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.config import ForkConfig, minimal_chain_config as cfg
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.flare import (
+    make_self_attester_slashing,
+    make_self_proposer_slashing,
+)
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ACTIVE_PRESET_NAME
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.state_transition.util.interop import interop_secret_keys
+from lodestar_tpu.validator.doppelganger import (
+    DoppelgangerService,
+    DoppelgangerStatus,
+)
+from lodestar_tpu.validator.keymanager import KeymanagerApiServer
+from lodestar_tpu.validator.keystore import create_keystore
+from lodestar_tpu.validator.slashing_protection import SlashingProtection
+from lodestar_tpu.validator.validator_store import ValidatorStore
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+
+class FakeTime:
+    def __init__(self, t):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestFlareSelfSlashing:
+    def test_attester_slashing_processes_through_state_transition(self):
+        """The crafted double vote must pass pool validation AND actually
+        slash the validator when included in a block."""
+        from lodestar_tpu.state_transition.block.phase0 import (
+            process_attester_slashing,
+        )
+
+        _, state = init_dev_state(cfg, 8, genesis_time=0)
+        from lodestar_tpu.state_transition import CachedBeaconState
+
+        cached = CachedBeaconState(cfg, state)
+        sk = interop_secret_keys(4)[3]
+        s = make_self_attester_slashing(
+            cfg, bytes(state.genesis_validators_root), sk, 3, target_epoch=0
+        )
+        assert not state.validators[3].slashed
+        process_attester_slashing(cfg, state, cached.epoch_ctx, s, True)
+        assert state.validators[3].slashed
+
+    def test_proposer_slashing_processes(self):
+        from lodestar_tpu.state_transition.block.phase0 import (
+            process_proposer_slashing,
+        )
+
+        _, state = init_dev_state(cfg, 8, genesis_time=0)
+        from lodestar_tpu.state_transition import CachedBeaconState
+
+        cached = CachedBeaconState(cfg, state)
+        sk = interop_secret_keys(3)[2]
+        s = make_self_proposer_slashing(
+            cfg, bytes(state.genesis_validators_root), sk, 2, slot=1
+        )
+        process_proposer_slashing(cfg, state, cached.epoch_ctx, s, True)
+        assert state.validators[2].slashed
+
+
+class TestDoppelganger:
+    def test_detection_and_clearance(self):
+        class FakeApi:
+            def __init__(self):
+                self.live = set()
+
+            async def get_liveness(self, epoch, indices):
+                return [
+                    {"index": str(i), "is_live": i in self.live} for i in indices
+                ]
+
+        async def run():
+            api = FakeApi()
+            dg = DoppelgangerService(api, remaining_epochs=2)
+            dg.register(1)
+            dg.register(2)
+            api.live = {2}  # someone else is running validator 2!
+            await dg.check_epoch(10)
+            assert dg.status(1) == DoppelgangerStatus.Unverified
+            assert dg.status(2) == DoppelgangerStatus.DoppelgangerDetected
+            await dg.check_epoch(11)
+            assert dg.status(1) == DoppelgangerStatus.VerifiedSafe
+            assert dg.is_safe(1) and not dg.is_safe(2)
+            assert dg.detected() == [2]
+
+        asyncio.run(run())
+
+
+class TestKeymanagerApi:
+    def test_list_import_delete_round_trip(self):
+        async def run():
+            sks = interop_secret_keys(2)
+            store = ValidatorStore([sks[0]], ForkConfig(cfg), b"\x11" * 32)
+            sp = SlashingProtection()
+            srv = KeymanagerApiServer(store, sp, b"\x11" * 32, port=15062)
+            await srv.start()
+            try:
+                import aiohttp
+
+                base = "http://127.0.0.1:15062"
+                async with aiohttp.ClientSession() as ses:
+                    async with ses.get(base + "/eth/v1/keystores") as r:
+                        data = (await r.json())["data"]
+                        assert len(data) == 1
+
+                    # import the second interop key as an EIP-2335 keystore
+                    ks = create_keystore(sks[1].to_bytes(), "pass123", kdf="pbkdf2")
+                    async with ses.post(
+                        base + "/eth/v1/keystores",
+                        json={"keystores": [json.dumps(ks)], "passwords": ["pass123"]},
+                    ) as r:
+                        statuses = (await r.json())["data"]
+                        assert statuses[0]["status"] == "imported"
+                    assert store.has(sks[1].to_public_key().to_bytes())
+
+                    # wrong password -> error status
+                    async with ses.post(
+                        base + "/eth/v1/keystores",
+                        json={"keystores": [json.dumps(ks)], "passwords": ["wrong"]},
+                    ) as r:
+                        statuses = (await r.json())["data"]
+                        assert statuses[0]["status"] in ("error", "duplicate")
+
+                    # delete exports slashing protection
+                    pk_hex = "0x" + sks[1].to_public_key().to_bytes().hex()
+                    async with ses.delete(
+                        base + "/eth/v1/keystores", json={"pubkeys": [pk_hex]}
+                    ) as r:
+                        body = await r.json()
+                        assert body["data"][0]["status"] == "deleted"
+                        interchange = json.loads(body["slashing_protection"])
+                        assert interchange["metadata"]["interchange_format_version"] == "5"
+                    assert not store.has(sks[1].to_public_key().to_bytes())
+            finally:
+                await srv.close()
+
+        asyncio.run(run())

@@ -1,0 +1,243 @@
+"""Directory spec-test harness: official consensus-spec-tests layout
+(ssz_snappy + yaml fixtures, absent-post = expected failure) exercised
+with locally generated vectors (reference: spec-test-util/src/single.ts
+describeDirectorySpecTest + test/spec/presets runners).
+"""
+import dataclasses
+
+import pytest
+
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.config import minimal_chain_config as cfg
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ACTIVE_PRESET_NAME, ForkName
+from lodestar_tpu.spec_test import (
+    SpecTestError,
+    run_directory_spec_test,
+    write_ssz_snappy,
+    write_yaml,
+)
+from lodestar_tpu.spec_test.runners import (
+    bls_runner,
+    make_operations_runner,
+    make_sanity_blocks_runner,
+    make_sanity_slots_runner,
+    make_ssz_static_runner,
+)
+from lodestar_tpu.state_transition import CachedBeaconState, process_slots
+from lodestar_tpu.types import ssz
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+E = _p.SLOTS_PER_EPOCH
+
+
+@pytest.fixture(scope="module")
+def dev():
+    chain = DevChain(cfg, validator_count=8, genesis_time=0)
+    chain.run_until(3, verify_signatures=False)
+    return chain
+
+
+class TestSanitySuites:
+    def test_sanity_slots(self, dev, tmp_path):
+        root = tmp_path / "sanity" / "slots"
+        pre = dev.head.clone()
+        post = dev.head.clone()
+        process_slots(post, post.state.slot + E)
+        case = root / "slots_cross_epoch"
+        write_ssz_snappy(str(case), "pre", ssz.phase0.BeaconState, pre.state)
+        write_yaml(str(case), "slots", E)
+        write_ssz_snappy(str(case), "post", ssz.phase0.BeaconState, post.state)
+        res = run_directory_spec_test(
+            str(root), make_sanity_slots_runner(cfg, ForkName.phase0)
+        )
+        res.assert_ok()
+        assert res.passed == ["slots_cross_epoch"]
+
+    def test_sanity_blocks_valid_and_invalid(self, dev, tmp_path):
+        root = tmp_path / "sanity" / "blocks"
+        pre = dev.head.clone()
+        block = dev.produce_block(pre.state.slot + 1)
+        from lodestar_tpu.state_transition import state_transition
+
+        post = state_transition(
+            pre, block, verify_state_root=True, verify_proposer=True,
+            verify_signatures=True,
+        )
+        ok_case = root / "valid_block"
+        write_ssz_snappy(str(ok_case), "pre", ssz.phase0.BeaconState, pre.state)
+        write_yaml(str(ok_case), "meta", {"blocks_count": 1})
+        write_ssz_snappy(str(ok_case), "blocks_0", ssz.phase0.SignedBeaconBlock, block)
+        write_ssz_snappy(str(ok_case), "post", ssz.phase0.BeaconState, post.state)
+
+        # invalid: corrupted proposer signature, NO post file
+        bad = ssz.phase0.SignedBeaconBlock.deserialize(
+            ssz.phase0.SignedBeaconBlock.serialize(block)
+        )
+        sig = bytearray(bytes(bad.signature))
+        sig[10] ^= 0xFF
+        bad.signature = bytes(sig)
+        bad_case = root / "invalid_proposer_sig"
+        write_ssz_snappy(str(bad_case), "pre", ssz.phase0.BeaconState, pre.state)
+        write_yaml(str(bad_case), "meta", {"blocks_count": 1})
+        write_ssz_snappy(str(bad_case), "blocks_0", ssz.phase0.SignedBeaconBlock, bad)
+
+        res = run_directory_spec_test(
+            str(root), make_sanity_blocks_runner(cfg, ForkName.phase0)
+        )
+        res.assert_ok()
+        assert set(res.passed) == {"valid_block", "invalid_proposer_sig"}
+
+    def test_harness_catches_wrong_post(self, dev, tmp_path):
+        """A fixture whose post does not match must FAIL the suite —
+        guards against a harness that silently passes everything."""
+        root = tmp_path / "sanity" / "slots"
+        case = root / "wrong_post"
+        pre = dev.head.clone()
+        write_ssz_snappy(str(case), "pre", ssz.phase0.BeaconState, pre.state)
+        write_yaml(str(case), "slots", 1)
+        write_ssz_snappy(str(case), "post", ssz.phase0.BeaconState, pre.state)
+        res = run_directory_spec_test(
+            str(root), make_sanity_slots_runner(cfg, ForkName.phase0)
+        )
+        assert res.failed == ["wrong_post"]
+        with pytest.raises(SpecTestError):
+            res.assert_ok()
+
+
+class TestOperationsSuite:
+    def test_attestation_operation(self, dev, tmp_path):
+        from lodestar_tpu.state_transition.block.phase0 import process_attestation
+
+        root = tmp_path / "operations" / "attestation"
+        atts = dev.attest(dev.head.state.slot)
+        pre = dev.head.clone()
+        process_slots(pre, pre.state.slot + 1)
+        post = pre.clone()
+        process_attestation(cfg, post.state, post.epoch_ctx, atts[0], True)
+
+        ok_case = root / "valid_attestation"
+        write_ssz_snappy(str(ok_case), "pre", ssz.phase0.BeaconState, pre.state)
+        write_ssz_snappy(str(ok_case), "attestation", ssz.phase0.Attestation, atts[0])
+        write_ssz_snappy(str(ok_case), "post", ssz.phase0.BeaconState, post.state)
+
+        # invalid: wrong source checkpoint, no post
+        bad = ssz.phase0.Attestation.deserialize(
+            ssz.phase0.Attestation.serialize(atts[0])
+        )
+        bad.data.source = ssz.phase0.Checkpoint(epoch=99, root=b"\x42" * 32)
+        bad_case = root / "invalid_source"
+        write_ssz_snappy(str(bad_case), "pre", ssz.phase0.BeaconState, pre.state)
+        write_ssz_snappy(str(bad_case), "attestation", ssz.phase0.Attestation, bad)
+
+        def apply(cfg_, cached, op):
+            process_attestation(cfg_, cached.state, cached.epoch_ctx, op, True)
+
+        res = run_directory_spec_test(
+            str(root),
+            make_operations_runner(
+                cfg, ForkName.phase0, "attestation", ssz.phase0.Attestation, apply
+            ),
+        )
+        res.assert_ok()
+        assert len(res.passed) == 2
+
+
+class TestSszStaticSuite:
+    def test_beacon_state_static(self, dev, tmp_path):
+        root = tmp_path / "ssz_static" / "BeaconState"
+        case = root / "case_0"
+        st = dev.head.state
+        write_ssz_snappy(str(case), "serialized", ssz.phase0.BeaconState, st)
+        write_yaml(
+            str(case),
+            "roots",
+            {"root": "0x" + ssz.phase0.BeaconState.hash_tree_root(st).hex()},
+        )
+        res = run_directory_spec_test(
+            str(root), make_ssz_static_runner(ssz.phase0.BeaconState),
+            uses_post=False,
+        )
+        res.assert_ok()
+
+
+class TestBlsSuite:
+    def test_bls_vectors(self, tmp_path):
+        sk = bls.SecretKey.from_bytes((7).to_bytes(32, "big"))
+        pk = sk.to_public_key()
+        msg = b"\xab" * 32
+        sig = sk.sign(msg)
+        root = tmp_path / "bls"
+
+        write_yaml(
+            str(root / "sign_case"),
+            "data",
+            {
+                "input": {
+                    "privkey": "0x" + (7).to_bytes(32, "big").hex(),
+                    "message": "0x" + msg.hex(),
+                },
+                "output": "0x" + sig.to_bytes().hex(),
+            },
+        )
+        write_yaml(
+            str(root / "verify_true"),
+            "data",
+            {
+                "input": {
+                    "pubkey": "0x" + pk.to_bytes().hex(),
+                    "message": "0x" + msg.hex(),
+                    "signature": "0x" + sig.to_bytes().hex(),
+                },
+                "output": True,
+            },
+        )
+        tampered = bytearray(sig.to_bytes())
+        tampered[5] ^= 0x04
+        write_yaml(
+            str(root / "verify_false_tampered"),
+            "data",
+            {
+                "input": {
+                    "pubkey": "0x" + pk.to_bytes().hex(),
+                    "message": "0x" + msg.hex(),
+                    "signature": "0x" + bytes(tampered).hex(),
+                },
+                "output": False,
+            },
+        )
+        sk2 = bls.SecretKey.from_bytes((9).to_bytes(32, "big"))
+        sig2 = sk2.sign(msg)
+        agg = bls.aggregate_signatures([sig, sig2])
+        write_yaml(
+            str(root / "aggregate_case"),
+            "data",
+            {
+                "input": [
+                    "0x" + sig.to_bytes().hex(),
+                    "0x" + sig2.to_bytes().hex(),
+                ],
+                "output": "0x" + agg.to_bytes().hex(),
+            },
+        )
+        write_yaml(
+            str(root / "fast_aggregate_verify_true"),
+            "data",
+            {
+                "input": {
+                    "pubkeys": [
+                        "0x" + pk.to_bytes().hex(),
+                        "0x" + sk2.to_public_key().to_bytes().hex(),
+                    ],
+                    "message": "0x" + msg.hex(),
+                    "signature": "0x" + agg.to_bytes().hex(),
+                },
+                "output": True,
+            },
+        )
+        res = run_directory_spec_test(str(root), bls_runner, suite="bls", uses_post=False)
+        res.assert_ok()
+        assert len(res.passed) == 5
