@@ -1,0 +1,29 @@
+"""Blobs sidecar production/retrieval (reference:
+packages/beacon-node/src/chain/produceBlock + db blobsSidecar flow for the
+eip4844 "block and blobs sidecar" era).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from lodestar_tpu.crypto import kzg
+from lodestar_tpu.types import ssz
+
+
+def build_blobs_sidecar(block_root: bytes, slot: int, blobs: Sequence[bytes]):
+    """Sidecar carrying `blobs` with one aggregated KZG proof (the proposer
+    side of validate_blobs_sidecar)."""
+    return ssz.eip4844.BlobsSidecar(
+        beacon_block_root=bytes(block_root),
+        beacon_block_slot=slot,
+        blobs=[bytes(b) for b in blobs],
+        kzg_aggregated_proof=kzg.compute_aggregate_kzg_proof(
+            [bytes(b) for b in blobs]
+        ),
+    )
+
+
+def empty_blobs_sidecar(block_root: bytes, slot: int):
+    """Every eip4844 block ships a sidecar even with zero blobs (spec
+    get_blobs_sidecar)."""
+    return build_blobs_sidecar(block_root, slot, [])

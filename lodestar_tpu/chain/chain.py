@@ -37,6 +37,7 @@ from .op_pools import (
 )
 from .regen import CheckpointStateCache, StateContextCache, StateRegenerator
 from .seen_cache import (
+    SeenBlsToExecutionChanges,
     SeenAggregatedAttestations,
     SeenAttesters,
     SeenBlockProposers,
@@ -220,6 +221,7 @@ class BeaconChain:
         self.seen_block_proposers = SeenBlockProposers()
         self.seen_sync_committee_messages = SeenSyncCommitteeMessages()
         self.seen_sync_contributions = SeenSyncCommitteeMessages()
+        self.seen_bls_to_execution_changes = SeenBlsToExecutionChanges()
 
         # block pipeline
         self.block_queue: JobItemQueue = JobItemQueue(

@@ -138,6 +138,15 @@ class DevChain:
         )
         if hasattr(body, "sync_aggregate"):
             body.sync_aggregate = self._make_sync_aggregate(pre, slot)
+        if hasattr(body, "execution_payload"):
+            from lodestar_tpu.state_transition.block.bellatrix import (
+                is_merge_transition_complete,
+            )
+
+            if is_merge_transition_complete(pre.state):
+                from lodestar_tpu.execution.engine import build_dev_payload
+
+                body.execution_payload = build_dev_payload(self.cfg, pre.state)
         block = block_t(
             slot=slot,
             proposer_index=proposer,

@@ -96,3 +96,18 @@ class SeenSyncCommitteeMessages:
 
     def prune(self, before_slot: int) -> None:
         self._seen = {k for k in self._seen if k[0] >= before_slot}
+
+
+class SeenBlsToExecutionChanges:
+    """First-seen dedup per validator index (the p2p IGNORE rule for
+    bls_to_execution_change; a validator changes credentials at most once,
+    so no pruning is needed)."""
+
+    def __init__(self):
+        self._seen = set()
+
+    def is_known(self, validator_index: int) -> bool:
+        return validator_index in self._seen
+
+    def add(self, validator_index: int) -> None:
+        self._seen.add(validator_index)

@@ -453,3 +453,83 @@ async def validate_sync_committee_contribution(
     chain.seen_sync_contributions.add(
         contribution.slot, contribution.subcommittee_index, cp.aggregator_index
     )
+
+
+# ---------------------------------------------------------------------------
+# eip4844 blobs (reference chain/validation/blobsSidecar.ts role; spec
+# eip4844 p2p-interface validate_blobs_sidecar)
+# ---------------------------------------------------------------------------
+
+
+def validate_blobs_sidecar(
+    slot: int, beacon_block_root: bytes, expected_kzg_commitments, sidecar
+) -> None:
+    """Spec validate_blobs_sidecar: sidecar must belong to the block and
+    its blobs must match the block's commitments via the aggregated proof."""
+    from lodestar_tpu.crypto import kzg
+
+    if sidecar.beacon_block_slot != slot:
+        raise GossipValidationError(
+            GossipErrorCode.BLOCK_SLOT_MISMATCH, "sidecar slot"
+        )
+    if bytes(sidecar.beacon_block_root) != bytes(beacon_block_root):
+        raise GossipValidationError(
+            GossipErrorCode.UNKNOWN_BEACON_BLOCK_ROOT, "sidecar root"
+        )
+    blobs = [bytes(b) for b in sidecar.blobs]
+    comms = [bytes(c) for c in expected_kzg_commitments]
+    if len(blobs) != len(comms):
+        raise GossipValidationError(
+            GossipErrorCode.INVALID_SIGNATURE, "blob/commitment count"
+        )
+    if not kzg.verify_aggregate_kzg_proof(
+        blobs, comms, bytes(sidecar.kzg_aggregated_proof)
+    ):
+        raise GossipValidationError(
+            GossipErrorCode.INVALID_SIGNATURE, "kzg aggregate proof"
+        )
+
+
+async def validate_gossip_block_and_blobs_sidecar(chain, pair) -> None:
+    """beacon_block_and_blobs_sidecar gossip: the block validates like a
+    normal gossip block, then the sidecar must prove the block's
+    blob_kzg_commitments."""
+    signed_block = pair.beacon_block
+    await validate_gossip_block(chain, signed_block)
+    block = signed_block.message
+    root = type(block).hash_tree_root(block)
+    validate_blobs_sidecar(
+        block.slot, root, list(block.body.blob_kzg_commitments), pair.blobs_sidecar
+    )
+
+
+# ---------------------------------------------------------------------------
+# capella bls_to_execution_change gossip (chain/validation/
+# blsToExecutionChange.ts role)
+# ---------------------------------------------------------------------------
+
+
+async def validate_gossip_bls_to_execution_change(chain, signed_change) -> None:
+    from lodestar_tpu.state_transition.block.capella import (
+        check_bls_to_execution_change_preconditions,
+        get_bls_to_execution_change_signature_set,
+    )
+
+    change = signed_change.message
+    # p2p IGNORE: only the first change per validator index propagates
+    if chain.seen_bls_to_execution_changes.is_known(change.validator_index):
+        raise GossipValidationError(
+            GossipErrorCode.ATTESTER_ALREADY_SEEN, "change already seen"
+        )
+    st = chain.get_head_state().state
+    try:
+        # same preconditions as the STF (block/capella.py) — one source of truth
+        check_bls_to_execution_change_preconditions(st, change)
+    except ValueError as e:
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, str(e))
+    sig_set = get_bls_to_execution_change_signature_set(chain.cfg, st, signed_change)
+    if not await chain.bls.verify_signature_sets(
+        [sig_set], VerifyOptions(batchable=True)
+    ):
+        raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)
+    chain.seen_bls_to_execution_changes.add(change.validator_index)

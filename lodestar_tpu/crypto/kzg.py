@@ -1,0 +1,360 @@
+"""KZG polynomial commitments for EIP-4844 blobs — the rebuild's `c-kzg`
+(reference: consumed via packages/beacon-node/src/util/kzg.ts, init at
+node/nodejs.ts:146-151; spec: consensus-specs eip4844
+polynomial-commitments.md).
+
+Built from scratch on the in-tree BLS12-381 oracle (crypto/bls): blobs are
+polynomials in evaluation form over a bit-reversed power-of-two subgroup of
+Fr; commitments/proofs are G1 multi-exponentiations against a Lagrange-form
+trusted setup; verification is a two-pairing check.
+
+Trusted setup: `dev_setup(n)` derives an INSECURE deterministic setup from
+a fixed secret tau — sufficient for dev chains and tests (the secret is
+public, so proofs can be forged; never use for mainnet).  A production
+setup in c-kzg's JSON format loads via `load_trusted_setup`.  The dev path
+computes Lagrange coefficients L_i(tau) directly in Fr (we know tau), so
+setup generation is n scalar muls, not a group FFT.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import List, Optional, Sequence, Tuple
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p, BYTES_PER_FIELD_ELEMENT
+from .bls import curve as cv, fields as ff, pairing as pr
+from .bls.curve import G1_GEN_JAC, G2_GEN_JAC, g1, g2
+from .bls.fields import R
+
+# Fiat-Shamir domain (spec polynomial-commitments.md)
+FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVERIFY_V1_"
+PRIMITIVE_ROOT_OF_UNITY = 7
+
+BYTES_PER_BLOB = BYTES_PER_FIELD_ELEMENT * _p.FIELD_ELEMENTS_PER_BLOB
+
+
+class KzgError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Fr helpers
+# ---------------------------------------------------------------------------
+
+
+def bytes_to_bls_field(b: bytes) -> int:
+    """Canonical little-endian field element (this spec era's encoding);
+    rejects non-canonical values like the spec's bytes_to_bls_field."""
+    x = int.from_bytes(b, "little")
+    if x >= R:
+        raise KzgError("non-canonical field element")
+    return x
+
+
+def bls_field_to_bytes(x: int) -> bytes:
+    return (x % R).to_bytes(BYTES_PER_FIELD_ELEMENT, "little")
+
+
+def hash_to_bls_field(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(data).digest(), "little") % R
+
+
+def compute_powers(x: int, n: int) -> List[int]:
+    out = []
+    acc = 1
+    for _ in range(n):
+        out.append(acc)
+        acc = acc * x % R
+    return out
+
+
+def _bit_reversal_permutation(seq: Sequence) -> List:
+    n = len(seq)
+    if n & (n - 1):
+        raise KzgError("length must be a power of two")
+    bits = n.bit_length() - 1
+    return [seq[int(format(i, f"0{bits}b")[::-1], 2)] for i in range(n)] if bits else list(seq)
+
+
+@lru_cache(maxsize=4)
+def roots_of_unity_brp(n: int) -> Tuple[int, ...]:
+    """Bit-reversal-permuted n-th roots of unity in Fr."""
+    if (R - 1) % n:
+        raise KzgError(f"no {n}-th roots of unity in Fr")
+    omega = pow(PRIMITIVE_ROOT_OF_UNITY, (R - 1) // n, R)
+    roots = []
+    acc = 1
+    for _ in range(n):
+        roots.append(acc)
+        acc = acc * omega % R
+    return tuple(_bit_reversal_permutation(roots))
+
+
+# ---------------------------------------------------------------------------
+# trusted setup
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TrustedSetup:
+    g1_lagrange: Tuple  # JacG1 per evaluation point (bit-reversed order)
+    g2_tau: object      # [tau]G2 (jacobian)
+    n: int
+
+
+_DEV_SECRET = int.from_bytes(hashlib.sha256(b"lodestar-tpu insecure dev tau").digest(), "big") % R
+
+
+@lru_cache(maxsize=2)
+def dev_setup(n: Optional[int] = None) -> TrustedSetup:
+    """INSECURE deterministic setup (tau is public — dev/test only)."""
+    n = n or _p.FIELD_ELEMENTS_PER_BLOB
+    tau = _DEV_SECRET
+    domain = roots_of_unity_brp(n)
+    n_inv = pow(n, R - 2, R)
+    zn = (pow(tau, n, R) - 1) % R  # tau^n - 1
+    points = []
+    for w in domain:
+        # L_w(tau) = w/n * (tau^n - 1)/(tau - w)
+        li = w * n_inv % R * zn % R * pow((tau - w) % R, R - 2, R) % R
+        points.append(g1.mul_scalar(G1_GEN_JAC, li))
+    return TrustedSetup(
+        g1_lagrange=tuple(points), g2_tau=g2.mul_scalar(G2_GEN_JAC, tau), n=n
+    )
+
+
+def load_trusted_setup(obj: dict) -> TrustedSetup:
+    """c-kzg-style JSON: {"setup_G1_lagrange": [hex48...],
+    "setup_G2": [hex96...]} (only [tau]G2 — index 1 — is needed)."""
+    g1_points = tuple(
+        g1.from_affine(cv.g1_from_bytes(bytes.fromhex(h.removeprefix("0x"))))
+        for h in obj["setup_G1_lagrange"]
+    )
+    g2_tau = g2.from_affine(
+        cv.g2_from_bytes(bytes.fromhex(obj["setup_G2"][1].removeprefix("0x")))
+    )
+    return TrustedSetup(g1_lagrange=g1_points, g2_tau=g2_tau, n=len(g1_points))
+
+
+_active_setup: Optional[TrustedSetup] = None
+
+
+def get_setup() -> TrustedSetup:
+    global _active_setup
+    if _active_setup is None:
+        _active_setup = dev_setup()
+    return _active_setup
+
+
+def set_setup(setup: Optional[TrustedSetup]) -> None:
+    global _active_setup
+    _active_setup = setup
+
+
+# ---------------------------------------------------------------------------
+# polynomial ops (evaluation form, bit-reversed domain)
+# ---------------------------------------------------------------------------
+
+
+def blob_to_polynomial(blob: bytes) -> List[int]:
+    if len(blob) != BYTES_PER_BLOB:
+        raise KzgError(f"blob must be {BYTES_PER_BLOB} bytes")
+    return [
+        bytes_to_bls_field(blob[i : i + BYTES_PER_FIELD_ELEMENT])
+        for i in range(0, len(blob), BYTES_PER_FIELD_ELEMENT)
+    ]
+
+
+def polynomial_to_blob(poly: Sequence[int]) -> bytes:
+    return b"".join(bls_field_to_bytes(x) for x in poly)
+
+
+def evaluate_polynomial_in_evaluation_form(poly: Sequence[int], z: int) -> int:
+    """Barycentric evaluation at an arbitrary point (spec
+    evaluate_polynomial_in_evaluation_form)."""
+    n = len(poly)
+    domain = roots_of_unity_brp(n)
+    if z in domain:
+        return poly[domain.index(z)]
+    zn_minus_1 = (pow(z, n, R) - 1) % R
+    n_inv = pow(n, R - 2, R)
+    total = 0
+    for f_i, w in zip(poly, domain):
+        total = (total + f_i * w % R * pow((z - w) % R, R - 2, R)) % R
+    return total * zn_minus_1 % R * n_inv % R
+
+
+def g1_lincomb(points: Sequence, scalars: Sequence[int]):
+    """MSM over jacobian G1 points (naive double-and-add per term; the
+    TPU MSM kernel is the future fast path — SURVEY §2.3 c-kzg row)."""
+    acc = (g1.one, g1.one, g1.zero)
+    for pt, s in zip(points, scalars):
+        if s:
+            acc = g1.add_pts(acc, g1.mul_scalar(pt, s))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# the eip4844 KZG API (blob_to_kzg_commitment / aggregate proofs)
+# ---------------------------------------------------------------------------
+
+
+def blob_to_kzg_commitment(blob: bytes, setup: Optional[TrustedSetup] = None) -> bytes:
+    setup = setup or get_setup()
+    poly = blob_to_polynomial(blob)
+    pt = g1_lincomb(setup.g1_lagrange, poly)
+    return cv.g1_to_bytes(g1.to_affine(pt))
+
+
+def verify_kzg_proof(
+    commitment: bytes, z: int, y: int, proof: bytes,
+    setup: Optional[TrustedSetup] = None,
+) -> bool:
+    """Pairing check e(P - y·G1, G2) == e(proof, tau·G2 - z·G2), i.e. the
+    quotient polynomial is consistent at tau."""
+    setup = setup or get_setup()
+    try:
+        c_aff = cv.g1_from_bytes(commitment)
+        p_aff = cv.g1_from_bytes(proof)
+    except Exception:
+        return False
+    c_jac = g1.from_affine(c_aff)
+    p_jac = g1.from_affine(p_aff)
+    # X - z in G2; commitment - y in G1
+    x_minus_z = g2.add_pts(
+        setup.g2_tau, g2.neg_pt(g2.mul_scalar(G2_GEN_JAC, z % R))
+    )
+    c_minus_y = g1.add_pts(c_jac, g1.neg_pt(g1.mul_scalar(G1_GEN_JAC, y % R)))
+    cmy_aff = g1.to_affine(c_minus_y)
+    xmz_aff = g2.to_affine(x_minus_z)
+    p_aff2 = g1.to_affine(p_jac)
+    # e(C - yG1, -G2) * e(proof, (tau-z)G2) == 1
+    f = ff.f12_mul(
+        pr.miller_loop(g2.to_affine(g2.neg_pt(G2_GEN_JAC)), cmy_aff)
+        if cmy_aff is not None
+        else _f12_one(),
+        pr.miller_loop(xmz_aff, p_aff2) if p_aff2 is not None and xmz_aff is not None else _f12_one(),
+    )
+    return ff.f12_is_one(pr.final_exponentiation(f))
+
+
+def _f12_one():
+    one = (((1, 0), (0, 0), (0, 0)), ((0, 0), (0, 0), (0, 0)))
+    return one
+
+
+def compute_quotient_eval_within_domain(
+    z: int, poly: Sequence[int], y: int
+) -> int:
+    """Quotient value at z when z IS a domain point (spec
+    compute_quotient_eval_within_domain)."""
+    domain = roots_of_unity_brp(len(poly))
+    result = 0
+    for f_i, w in zip(poly, domain):
+        if w == z:
+            continue
+        num = (f_i - y) % R * w % R
+        den = z * ((z - w) % R) % R
+        result = (result + num * pow(den, R - 2, R)) % R
+    return result
+
+
+def compute_kzg_proof_from_poly(
+    poly: Sequence[int], z: int, setup: Optional[TrustedSetup] = None
+) -> Tuple[bytes, int]:
+    """(proof, y) for p(z) = y via the evaluation-form quotient."""
+    setup = setup or get_setup()
+    domain = roots_of_unity_brp(len(poly))
+    y = evaluate_polynomial_in_evaluation_form(poly, z)
+    quotient = []
+    for f_i, w in zip(poly, domain):
+        if w == z:
+            quotient.append(compute_quotient_eval_within_domain(z, poly, y))
+        else:
+            quotient.append((f_i - y) % R * pow((w - z) % R, R - 2, R) % R)
+    pt = g1_lincomb(setup.g1_lagrange, quotient)
+    return cv.g1_to_bytes(g1.to_affine(pt)), y
+
+
+def compute_kzg_proof(blob: bytes, z: int, setup: Optional[TrustedSetup] = None) -> Tuple[bytes, int]:
+    return compute_kzg_proof_from_poly(blob_to_polynomial(blob), z, setup)
+
+
+# --- aggregation (this spec era: one aggregated proof per BlobsSidecar) ----
+
+
+def _g1_identity_bytes() -> bytes:
+    return bytes([0xC0]) + b"\x00" * 47
+
+
+def compute_aggregated_poly_and_commitment(
+    blobs: Sequence[bytes], commitments: Sequence[bytes]
+) -> Tuple[List[int], bytes, int]:
+    """(agg_poly, agg_commitment, evaluation challenge r) via Fiat-Shamir
+    over the blobs and commitments (spec
+    compute_aggregated_poly_and_commitment)."""
+    h = hashlib.sha256()
+    h.update(FIAT_SHAMIR_PROTOCOL_DOMAIN)
+    h.update(len(blobs).to_bytes(8, "little"))
+    h.update(_p.FIELD_ELEMENTS_PER_BLOB.to_bytes(8, "little"))
+    for b in blobs:
+        h.update(b)
+    for c in commitments:
+        h.update(bytes(c))
+    r = int.from_bytes(h.digest(), "little") % R
+    r_powers = compute_powers(r, len(blobs))
+
+    n = _p.FIELD_ELEMENTS_PER_BLOB
+    agg_poly = [0] * n
+    for rp, blob in zip(r_powers, blobs):
+        for i, f in enumerate(blob_to_polynomial(blob)):
+            agg_poly[i] = (agg_poly[i] + rp * f) % R
+
+    pts = [g1.from_affine(cv.g1_from_bytes(bytes(c))) for c in commitments]
+    agg_pt = g1_lincomb(pts, r_powers)
+    agg_aff = g1.to_affine(agg_pt)
+    agg_comm = cv.g1_to_bytes(agg_aff) if agg_aff is not None else _g1_identity_bytes()
+    return agg_poly, agg_comm, r
+
+
+def _evaluation_challenge(agg_poly: Sequence[int], agg_comm: bytes) -> int:
+    h = hashlib.sha256()
+    h.update(FIAT_SHAMIR_PROTOCOL_DOMAIN)
+    h.update(polynomial_to_blob(agg_poly))
+    h.update(agg_comm)
+    return int.from_bytes(h.digest(), "little") % R
+
+
+def compute_aggregate_kzg_proof(
+    blobs: Sequence[bytes], setup: Optional[TrustedSetup] = None
+) -> bytes:
+    if not blobs:
+        return _g1_identity_bytes()
+    commitments = [blob_to_kzg_commitment(b, setup) for b in blobs]
+    agg_poly, agg_comm, _ = compute_aggregated_poly_and_commitment(blobs, commitments)
+    x = _evaluation_challenge(agg_poly, agg_comm)
+    proof, _y = compute_kzg_proof_from_poly(agg_poly, x, setup)
+    return proof
+
+
+def verify_aggregate_kzg_proof(
+    blobs: Sequence[bytes],
+    commitments: Sequence[bytes],
+    proof: bytes,
+    setup: Optional[TrustedSetup] = None,
+) -> bool:
+    if len(blobs) != len(commitments):
+        return False
+    if not blobs:
+        return bytes(proof) == _g1_identity_bytes()
+    try:
+        agg_poly, agg_comm, _ = compute_aggregated_poly_and_commitment(
+            blobs, commitments
+        )
+    except (KzgError, ValueError):
+        # malformed blob field elements / commitment bytes
+        return False
+    x = _evaluation_challenge(agg_poly, agg_comm)
+    y = evaluate_polynomial_in_evaluation_form(agg_poly, x)
+    return verify_kzg_proof(agg_comm, x, y, proof, setup)

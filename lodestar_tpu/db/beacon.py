@@ -37,12 +37,8 @@ class MultiForkType:
         return t.deserialize(data[1:])
 
 
-_SIGNED_BLOCK_MF = MultiForkType(
-    {f: types_for(f)[2] for f in (ForkName.phase0, ForkName.altair)}
-)
-_STATE_MF = MultiForkType(
-    {f: types_for(f)[0] for f in (ForkName.phase0, ForkName.altair)}
-)
+_SIGNED_BLOCK_MF = MultiForkType({f: types_for(f)[2] for f in FORK_ORDER})
+_STATE_MF = MultiForkType({f: types_for(f)[0] for f in FORK_ORDER})
 
 
 class _RootRepo(Repository):
@@ -108,6 +104,17 @@ class BeaconDb:
         )
         self.backfilled_ranges = Repository(
             db, Bucket.backfilled_ranges, uint64
+        )
+        # eip4844 blobs sidecars (repositories/blobsSidecar.ts): hot by
+        # block root, archived by slot after finalization
+        self.blobs_sidecar = _RootRepo(
+            db,
+            Bucket.allForks_blobsSidecar,
+            ssz.eip4844.BlobsSidecar,
+            lambda sc: bytes(sc.beacon_block_root),
+        )
+        self.blobs_sidecar_archive = Repository(
+            db, Bucket.allForks_blobsSidecarArchive, ssz.eip4844.BlobsSidecar
         )
 
     def close(self) -> None:

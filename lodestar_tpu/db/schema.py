@@ -36,6 +36,8 @@ class Bucket(IntEnum):
     lightClient_bestLightClientUpdate = 55
     validator_metaData = 41
     backfilled_ranges = 42
+    allForks_blobsSidecar = 60          # Root -> BlobsSidecar (hot)
+    allForks_blobsSidecarArchive = 61   # Slot -> BlobsSidecar (finalized)
 
 
 def encode_key(bucket: Bucket, key: bytes) -> bytes:

@@ -38,6 +38,78 @@ class ExecutionEngine(Protocol):
     async def get_payload(self, payload_id: bytes): ...
 
 
+def _mock_block_hash(parent_hash: bytes, prev_randao: bytes, timestamp: int) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(
+        b"lodestar-tpu-mock-el"
+        + bytes(parent_hash)
+        + bytes(prev_randao)
+        + int(timestamp).to_bytes(8, "little")
+    ).digest()
+
+
+def build_payload(
+    fork,
+    parent_hash: bytes,
+    timestamp: int,
+    prev_randao: bytes,
+    fee_recipient: bytes = b"\x00" * 20,
+    withdrawals=(),
+    block_number: int = 0,
+    transactions=(),
+):
+    """Deterministic mock ExecutionPayload for `fork`, chained by
+    block_hash (engine/mock.ts fakeBlockProductionLoop role)."""
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.types import ssz
+
+    mod = getattr(ssz, fork.value)
+    payload = mod.ExecutionPayload.default()
+    payload.parent_hash = bytes(parent_hash)
+    payload.fee_recipient = bytes(fee_recipient)
+    payload.prev_randao = bytes(prev_randao)
+    payload.block_number = block_number
+    payload.gas_limit = 30_000_000
+    payload.timestamp = int(timestamp)
+    payload.base_fee_per_gas = 7
+    payload.transactions = list(transactions)
+    if hasattr(payload, "withdrawals"):
+        payload.withdrawals = list(withdrawals)
+    payload.block_hash = _mock_block_hash(parent_hash, prev_randao, timestamp)
+    return payload
+
+
+def build_dev_payload(cfg, state, transactions=()):
+    """Payload valid for the next block on `state` (already advanced to the
+    block's slot): satisfies every process_execution_payload consistency
+    check (parent_hash / prev_randao / timestamp)."""
+    from lodestar_tpu.params import ACTIVE_PRESET as _p
+    from lodestar_tpu.types import fork_of_state
+
+    fork = fork_of_state(state)
+    epoch = state.slot // _p.SLOTS_PER_EPOCH
+    prev_randao = bytes(
+        state.randao_mixes[epoch % _p.EPOCHS_PER_HISTORICAL_VECTOR]
+    )
+    withdrawals = ()
+    if hasattr(state, "next_withdrawal_index"):
+        from lodestar_tpu.state_transition.block.capella import (
+            get_expected_withdrawals,
+        )
+
+        withdrawals = get_expected_withdrawals(state)
+    return build_payload(
+        fork,
+        parent_hash=bytes(state.latest_execution_payload_header.block_hash),
+        timestamp=state.genesis_time + state.slot * cfg.SECONDS_PER_SLOT,
+        prev_randao=prev_randao,
+        withdrawals=withdrawals,
+        block_number=state.latest_execution_payload_header.block_number + 1,
+        transactions=transactions,
+    )
+
+
 class MockExecutionEngine:
     """Accept-everything EL double with payload building
     (engine/mock.ts)."""
@@ -49,8 +121,20 @@ class MockExecutionEngine:
         self.notified_payloads = 0
 
     async def notify_new_payload(self, payload) -> PayloadStatus:
+        return self.notify_new_payload_sync_status(payload)
+
+    def notify_new_payload_sync_status(self, payload) -> PayloadStatus:
         self.notified_payloads += 1
-        return PayloadStatus(ExecutePayloadStatus.VALID, getattr(payload, "block_hash", None))
+        return PayloadStatus(
+            ExecutePayloadStatus.VALID, getattr(payload, "block_hash", None)
+        )
+
+    def notify_new_payload_sync(self, payload) -> bool:
+        """Synchronous accept/reject used by the STF's optional engine hook
+        (process_execution_payload)."""
+        return self.notify_new_payload_sync_status(payload).status is (
+            ExecutePayloadStatus.VALID
+        )
 
     async def notify_forkchoice_update(
         self, head_block_hash, safe_block_hash, finalized_block_hash,
@@ -60,14 +144,26 @@ class MockExecutionEngine:
         self.finalized = finalized_block_hash
         if payload_attributes is not None:
             pid = secrets.token_bytes(8)
-            self._payloads[pid] = payload_attributes
+            self._payloads[pid] = (head_block_hash, dict(payload_attributes))
             return pid
         return None
 
     async def get_payload(self, payload_id: bytes):
+        """Build the payload promised by a forkchoiceUpdated with
+        attributes: {fork, timestamp, prev_randao, suggested_fee_recipient,
+        withdrawals?, block_number?}."""
         if payload_id not in self._payloads:
             raise ValueError("unknown payloadId")
-        return self._payloads.pop(payload_id)
+        parent_hash, attrs = self._payloads.pop(payload_id)
+        return build_payload(
+            attrs["fork"],
+            parent_hash=parent_hash,
+            timestamp=attrs["timestamp"],
+            prev_randao=attrs["prev_randao"],
+            fee_recipient=attrs.get("suggested_fee_recipient", b"\x00" * 20),
+            withdrawals=attrs.get("withdrawals", ()),
+            block_number=attrs.get("block_number", 0),
+        )
 
 
 class HttpExecutionEngine:

@@ -34,6 +34,10 @@ class GossipType(str, Enum):
     sync_committee = "sync_committee"
     light_client_finality_update = "light_client_finality_update"
     light_client_optimistic_update = "light_client_optimistic_update"
+    # eip4844: block travels with its blobs sidecar (topic.ts:53-66
+    # beacon_block_and_blobs_sidecar)
+    beacon_block_and_blobs_sidecar = "beacon_block_and_blobs_sidecar"
+    bls_to_execution_change = "bls_to_execution_change"
 
 
 # per-topic queue policy (gossip/validation/queue.ts:13-28)
@@ -48,6 +52,8 @@ QUEUE_OPTS: Dict[GossipType, dict] = {
     GossipType.sync_committee: dict(max_length=4096, queue_type=QueueType.LIFO, max_concurrency=64),
     GossipType.light_client_finality_update: dict(max_length=1024, queue_type=QueueType.FIFO, max_concurrency=4),
     GossipType.light_client_optimistic_update: dict(max_length=1024, queue_type=QueueType.FIFO, max_concurrency=4),
+    GossipType.beacon_block_and_blobs_sidecar: dict(max_length=1024, queue_type=QueueType.FIFO, max_concurrency=64),
+    GossipType.bls_to_execution_change: dict(max_length=4096, queue_type=QueueType.FIFO, max_concurrency=4),
 }
 
 

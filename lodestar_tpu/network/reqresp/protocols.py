@@ -56,7 +56,49 @@ BEACON_BLOCKS_BY_ROOT = Protocol(
     signed_block_wire_codec, max_response_chunks=1024,
 )
 
+
+# eip4844 blobs (reference network/reqresp/types.ts BlobsSidecarsByRange /
+# BeaconBlockAndBlobsSidecarByRoot)
+class BlobsSidecarsByRangeRequest(Container):
+    start_slot: uint64
+    count: uint64
+
+
+BLOBS_SIDECARS_BY_RANGE = Protocol(
+    "blobs_sidecars_by_range", 1, BlobsSidecarsByRangeRequest,
+    ssz.eip4844.BlobsSidecar, max_response_chunks=128,
+)
+BEACON_BLOCK_AND_BLOBS_SIDECAR_BY_ROOT = Protocol(
+    "beacon_block_and_blobs_sidecar_by_root", 1, BeaconBlocksByRootRequest,
+    ssz.eip4844.SignedBeaconBlockAndBlobsSidecar, max_response_chunks=1024,
+)
+
+
+# light client (reference reqresp/protocols/LightClient*.ts)
+class LightClientUpdatesByRangeRequest(Container):
+    start_period: uint64
+    count: uint64
+
+
+LIGHT_CLIENT_BOOTSTRAP = Protocol(
+    "light_client_bootstrap", 1, Bytes32, ssz.altair.LightClientBootstrap
+)
+LIGHT_CLIENT_UPDATES_BY_RANGE = Protocol(
+    "light_client_updates_by_range", 1, LightClientUpdatesByRangeRequest,
+    ssz.altair.LightClientUpdate, max_response_chunks=128,
+)
+LIGHT_CLIENT_FINALITY_UPDATE = Protocol(
+    "light_client_finality_update", 1, None, ssz.altair.LightClientFinalityUpdate
+)
+LIGHT_CLIENT_OPTIMISTIC_UPDATE = Protocol(
+    "light_client_optimistic_update", 1, None, ssz.altair.LightClientOptimisticUpdate
+)
+
 ALL_PROTOCOLS = [
-    STATUS, GOODBYE, PING, METADATA, BEACON_BLOCKS_BY_RANGE, BEACON_BLOCKS_BY_ROOT
+    STATUS, GOODBYE, PING, METADATA, BEACON_BLOCKS_BY_RANGE,
+    BEACON_BLOCKS_BY_ROOT, BLOBS_SIDECARS_BY_RANGE,
+    BEACON_BLOCK_AND_BLOBS_SIDECAR_BY_ROOT, LIGHT_CLIENT_BOOTSTRAP,
+    LIGHT_CLIENT_UPDATES_BY_RANGE, LIGHT_CLIENT_FINALITY_UPDATE,
+    LIGHT_CLIENT_OPTIMISTIC_UPDATE,
 ]
 BY_ID = {p.protocol_id: p for p in ALL_PROTOCOLS}

@@ -1,0 +1,106 @@
+"""Bellatrix (merge) block processing (reference:
+packages/state-transition/src/block/processExecutionPayload.ts and the
+bellatrix branches of block/index.ts; consensus-specs
+bellatrix/beacon-chain.md).
+
+The execution-engine `notify_new_payload` call is decoupled like the
+reference: the chain pipeline verifies the payload against the EL in
+parallel (chain/blocks/verifyBlock.ts:71-80), and the STF only checks
+consensus-visible payload consistency unless an engine is passed in.
+"""
+from __future__ import annotations
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ForkName
+from lodestar_tpu.types import fork_of_state, ssz
+from ..epoch_context import EpochContext
+from ..util.misc import compute_epoch_at_slot, get_randao_mix
+from . import altair as ba, phase0 as b0
+from .process_deposit import process_deposit
+
+
+def is_merge_transition_complete(state) -> bool:
+    header_t = type(state)._fields_["latest_execution_payload_header"]
+    return state.latest_execution_payload_header != header_t.default()
+
+
+def is_merge_transition_block(state, body) -> bool:
+    payload_t = type(body)._fields_["execution_payload"]
+    return (
+        not is_merge_transition_complete(state)
+        and body.execution_payload != payload_t.default()
+    )
+
+
+def is_execution_enabled(state, body) -> bool:
+    return is_merge_transition_block(state, body) or is_merge_transition_complete(state)
+
+
+def compute_timestamp_at_slot(cfg, state, slot: int) -> int:
+    slots_since_genesis = slot - 0
+    return state.genesis_time + slots_since_genesis * cfg.SECONDS_PER_SLOT
+
+
+def process_execution_payload(cfg, state, body, execution_engine=None) -> None:
+    """Spec process_execution_payload: consistency checks + header store.
+
+    The parent_hash check is gated on merge completion only for bellatrix;
+    capella+ assert it unconditionally (capella/beacon-chain.md)."""
+    from lodestar_tpu.params import FORK_SEQ
+
+    payload = body.execution_payload
+    fork = fork_of_state(state)
+    post_capella = FORK_SEQ[fork] >= FORK_SEQ[ForkName.capella]
+    if post_capella or is_merge_transition_complete(state):
+        if bytes(payload.parent_hash) != bytes(
+            state.latest_execution_payload_header.block_hash
+        ):
+            raise ValueError("execution payload parent_hash mismatch")
+    epoch = compute_epoch_at_slot(state.slot)
+    if bytes(payload.prev_randao) != get_randao_mix(state, epoch):
+        raise ValueError("execution payload prev_randao mismatch")
+    if payload.timestamp != compute_timestamp_at_slot(cfg, state, state.slot):
+        raise ValueError("execution payload timestamp mismatch")
+    if execution_engine is not None:
+        if not execution_engine.notify_new_payload_sync(payload):
+            raise ValueError("execution engine rejected payload")
+    # fork-matched header conversion (bellatrix/capella/eip4844 modules each
+    # export payload_to_header for their payload shape)
+    fork = fork_of_state(state)
+    mod = getattr(ssz, fork.value)
+    state.latest_execution_payload_header = mod.payload_to_header(payload)
+
+
+def process_block(
+    cfg, state, epoch_ctx: EpochContext, block, verify_signatures: bool = True,
+    execution_engine=None,
+) -> None:
+    b0.process_block_header(cfg, state, epoch_ctx, block)
+    if is_execution_enabled(state, block.body):
+        process_execution_payload(cfg, state, block.body, execution_engine)
+    b0.process_randao(cfg, state, epoch_ctx, block.body, verify_signatures)
+    b0.process_eth1_data(cfg, state, block.body)
+    process_operations(cfg, state, epoch_ctx, block.body, verify_signatures)
+    ba.process_sync_aggregate(cfg, state, epoch_ctx, block, verify_signatures)
+
+
+def process_operations(
+    cfg, state, epoch_ctx: EpochContext, body, verify_signatures: bool = True
+) -> None:
+    expected_deposits = min(
+        _p.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    if len(body.deposits) != expected_deposits:
+        raise ValueError(
+            f"expected {expected_deposits} deposits, block has {len(body.deposits)}"
+        )
+    for ps in body.proposer_slashings:
+        b0.process_proposer_slashing(cfg, state, epoch_ctx, ps, verify_signatures)
+    for asl in body.attester_slashings:
+        b0.process_attester_slashing(cfg, state, epoch_ctx, asl, verify_signatures)
+    for att in body.attestations:
+        ba.process_attestation(cfg, state, epoch_ctx, att, verify_signatures)
+    for dep in body.deposits:
+        process_deposit(ForkName.bellatrix, cfg, state, dep, epoch_ctx.pubkey2index)
+    for ex in body.voluntary_exits:
+        b0.process_voluntary_exit(cfg, state, epoch_ctx, ex, verify_signatures)

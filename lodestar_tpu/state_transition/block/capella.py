@@ -1,0 +1,199 @@
+"""Capella block processing (reference:
+packages/state-transition/src/block/{processWithdrawals,
+processBlsToExecutionChange}.ts; consensus-specs capella/beacon-chain.md).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    BLS_WITHDRAWAL_PREFIX,
+    DOMAIN_BLS_TO_EXECUTION_CHANGE,
+    ETH1_ADDRESS_WITHDRAWAL_PREFIX,
+    FAR_FUTURE_EPOCH,
+    ForkName,
+)
+from lodestar_tpu.types import ssz
+from ..epoch_context import EpochContext
+from ..util.domain import compute_domain, compute_signing_root
+from ..util.misc import compute_epoch_at_slot, decrease_balance, sha256
+from . import altair as ba, bellatrix as bm, phase0 as b0
+from .process_deposit import process_deposit
+
+
+def has_eth1_withdrawal_credential(validator) -> bool:
+    return bytes(validator.withdrawal_credentials)[:1] == bytes(
+        [ETH1_ADDRESS_WITHDRAWAL_PREFIX]
+    )
+
+
+def is_fully_withdrawable_validator(validator, balance: int, epoch: int) -> bool:
+    return (
+        has_eth1_withdrawal_credential(validator)
+        and validator.withdrawable_epoch <= epoch
+        and balance > 0
+    )
+
+
+def is_partially_withdrawable_validator(validator, balance: int) -> bool:
+    return (
+        has_eth1_withdrawal_credential(validator)
+        and validator.effective_balance == _p.MAX_EFFECTIVE_BALANCE
+        and balance > _p.MAX_EFFECTIVE_BALANCE
+    )
+
+
+def get_expected_withdrawals(state) -> List:
+    """Spec get_expected_withdrawals: the bounded validator sweep from
+    next_withdrawal_validator_index."""
+    epoch = compute_epoch_at_slot(state.slot)
+    withdrawal_index = state.next_withdrawal_index
+    validator_index = state.next_withdrawal_validator_index
+    withdrawals = []
+    n = len(state.validators)
+    for _ in range(min(n, _p.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
+        v = state.validators[validator_index]
+        balance = state.balances[validator_index]
+        if is_fully_withdrawable_validator(v, balance, epoch):
+            withdrawals.append(
+                ssz.capella.Withdrawal(
+                    index=withdrawal_index,
+                    validator_index=validator_index,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance,
+                )
+            )
+            withdrawal_index += 1
+        elif is_partially_withdrawable_validator(v, balance):
+            withdrawals.append(
+                ssz.capella.Withdrawal(
+                    index=withdrawal_index,
+                    validator_index=validator_index,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance - _p.MAX_EFFECTIVE_BALANCE,
+                )
+            )
+            withdrawal_index += 1
+        if len(withdrawals) == _p.MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        validator_index = (validator_index + 1) % n
+    return withdrawals
+
+
+def process_withdrawals(cfg, state, payload) -> None:
+    expected = get_expected_withdrawals(state)
+    got = list(payload.withdrawals)
+    if len(got) != len(expected):
+        raise ValueError(
+            f"withdrawals count mismatch: payload {len(got)} != expected {len(expected)}"
+        )
+    for w, e in zip(got, expected):
+        if w != e:
+            raise ValueError("withdrawal mismatch")
+        decrease_balance(state, w.validator_index, w.amount)
+    if expected:
+        state.next_withdrawal_index = expected[-1].index + 1
+    n = len(state.validators)
+    if len(expected) == _p.MAX_WITHDRAWALS_PER_PAYLOAD:
+        # the sweep stopped at the last withdrawal — resume after it
+        state.next_withdrawal_validator_index = (
+            expected[-1].validator_index + 1
+        ) % n
+    else:
+        # full sweep bound hit — resume after the sweep window (spec uses
+        # the RAW sweep constant even when it exceeds the validator count)
+        state.next_withdrawal_validator_index = (
+            state.next_withdrawal_validator_index
+            + _p.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+        ) % n
+
+
+def get_bls_to_execution_change_signature_set(cfg, state, signed_change):
+    """BLSToExecutionChange signs with GENESIS fork version regardless of
+    the current fork (spec process_bls_to_execution_change)."""
+    change = signed_change.message
+    domain = compute_domain(
+        DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        cfg.GENESIS_FORK_VERSION,
+        bytes(state.genesis_validators_root),
+    )
+    signing_root = compute_signing_root(
+        ssz.capella.BLSToExecutionChange, change, domain
+    )
+    return bls.SignatureSet(
+        bls.PublicKey.from_bytes(bytes(change.from_bls_pubkey)),
+        signing_root,
+        bls.Signature.from_bytes(bytes(signed_change.signature)),
+    )
+
+
+def check_bls_to_execution_change_preconditions(state, change) -> None:
+    """Stateless validity checks shared by the STF and gossip validation
+    (raises ValueError on failure)."""
+    if change.validator_index >= len(state.validators):
+        raise ValueError("bls_to_execution_change: unknown validator")
+    v = state.validators[change.validator_index]
+    creds = bytes(v.withdrawal_credentials)
+    if creds[:1] != bytes([BLS_WITHDRAWAL_PREFIX]):
+        raise ValueError("bls_to_execution_change: not BLS credentials")
+    if creds[1:] != sha256(bytes(change.from_bls_pubkey))[1:]:
+        raise ValueError("bls_to_execution_change: pubkey/credentials mismatch")
+
+
+def process_bls_to_execution_change(
+    cfg, state, signed_change, verify_signature: bool = True
+) -> None:
+    change = signed_change.message
+    check_bls_to_execution_change_preconditions(state, change)
+    v = state.validators[change.validator_index]
+    if verify_signature and not bls.verify_signature_set(
+        get_bls_to_execution_change_signature_set(cfg, state, signed_change)
+    ):
+        raise ValueError("bls_to_execution_change: invalid signature")
+    v.withdrawal_credentials = (
+        bytes([ETH1_ADDRESS_WITHDRAWAL_PREFIX])
+        + b"\x00" * 11
+        + bytes(change.to_execution_address)
+    )
+
+
+def process_operations(
+    cfg, state, epoch_ctx: EpochContext, body, verify_signatures: bool = True,
+    deposit_fork: ForkName = ForkName.capella,
+) -> None:
+    expected_deposits = min(
+        _p.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    if len(body.deposits) != expected_deposits:
+        raise ValueError(
+            f"expected {expected_deposits} deposits, block has {len(body.deposits)}"
+        )
+    for ps in body.proposer_slashings:
+        b0.process_proposer_slashing(cfg, state, epoch_ctx, ps, verify_signatures)
+    for asl in body.attester_slashings:
+        b0.process_attester_slashing(cfg, state, epoch_ctx, asl, verify_signatures)
+    for att in body.attestations:
+        ba.process_attestation(cfg, state, epoch_ctx, att, verify_signatures)
+    for dep in body.deposits:
+        process_deposit(deposit_fork, cfg, state, dep, epoch_ctx.pubkey2index)
+    for ex in body.voluntary_exits:
+        b0.process_voluntary_exit(cfg, state, epoch_ctx, ex, verify_signatures)
+    for chg in body.bls_to_execution_changes:
+        process_bls_to_execution_change(cfg, state, chg, verify_signatures)
+
+
+def process_block(
+    cfg, state, epoch_ctx: EpochContext, block, verify_signatures: bool = True,
+    execution_engine=None,
+) -> None:
+    b0.process_block_header(cfg, state, epoch_ctx, block)
+    if bm.is_execution_enabled(state, block.body):
+        process_withdrawals(cfg, state, block.body.execution_payload)
+        bm.process_execution_payload(cfg, state, block.body, execution_engine)
+    b0.process_randao(cfg, state, epoch_ctx, block.body, verify_signatures)
+    b0.process_eth1_data(cfg, state, block.body)
+    process_operations(cfg, state, epoch_ctx, block.body, verify_signatures)
+    ba.process_sync_aggregate(cfg, state, epoch_ctx, block, verify_signatures)

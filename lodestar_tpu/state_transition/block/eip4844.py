@@ -1,0 +1,96 @@
+"""EIP-4844 block processing (reference: eip4844 branches of
+packages/state-transition/src/block/index.ts; consensus-specs
+eip4844/beacon-chain.md).
+
+Adds the blob-kzg-commitments ↔ blob-transactions consistency check on top
+of the capella pipeline.  KZG proof verification of the actual blobs
+happens at gossip/import time against the BlobsSidecar (reference
+chain/blocks flow), not in the STF.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from lodestar_tpu.params import (
+    ACTIVE_PRESET as _p,
+    VERSIONED_HASH_VERSION_KZG,
+    ForkName,
+)
+from ..epoch_context import EpochContext
+from ..util.misc import sha256
+from . import altair as ba, bellatrix as bm, capella as bc, phase0 as b0
+
+# SSZ-typed blob transaction tag (consensus-specs eip4844 beacon-chain.md)
+BLOB_TX_TYPE = 0x05
+# fixed-field span of ECDSASignedBlobTransaction.message before the
+# blob_versioned_hashes offset: chain_id(32) nonce(8) max_priority_fee(32)
+# max_fee(32) gas(8) to_offset(4) value(32) data_offset(4)
+# access_list_offset(4) max_fee_per_data_gas(32) = 188
+_BLOB_HASHES_OFFSET_POS = 188
+
+
+def kzg_commitment_to_versioned_hash(commitment: bytes) -> bytes:
+    return bytes([VERSIONED_HASH_VERSION_KZG]) + sha256(bytes(commitment))[1:]
+
+
+def tx_peek_blob_versioned_hashes(opaque_tx: bytes) -> List[bytes]:
+    """Spec tx_peek_blob_versioned_hashes: offset-walk the opaque
+    SSZ-serialized SignedBlobTransaction without a full decode."""
+    tx = bytes(opaque_tx)
+    if not tx or tx[0] != BLOB_TX_TYPE:
+        raise ValueError("not a blob transaction")
+    if len(tx) < 5:
+        raise ValueError("truncated blob transaction")
+    message_offset = 1 + int.from_bytes(tx[1:5], "little")
+    pos = message_offset + _BLOB_HASHES_OFFSET_POS
+    if pos + 4 > len(tx):
+        raise ValueError("truncated blob transaction")
+    hashes_offset = message_offset + int.from_bytes(tx[pos : pos + 4], "little")
+    if (
+        hashes_offset < pos + 4
+        or hashes_offset > len(tx)
+        or (len(tx) - hashes_offset) % 32
+    ):
+        raise ValueError("malformed blob transaction")
+    return [tx[x : x + 32] for x in range(hashes_offset, len(tx), 32)]
+
+
+def verify_kzg_commitments_against_transactions(
+    transactions: Sequence[bytes], kzg_commitments: Sequence[bytes]
+) -> bool:
+    all_versioned_hashes: List[bytes] = []
+    for tx in transactions:
+        tx = bytes(tx)
+        if tx and tx[0] == BLOB_TX_TYPE:
+            try:
+                all_versioned_hashes += tx_peek_blob_versioned_hashes(tx)
+            except ValueError:
+                return False
+    return all_versioned_hashes == [
+        kzg_commitment_to_versioned_hash(c) for c in kzg_commitments
+    ]
+
+
+def process_blob_kzg_commitments(cfg, state, body) -> None:
+    if not verify_kzg_commitments_against_transactions(
+        list(body.execution_payload.transactions), list(body.blob_kzg_commitments)
+    ):
+        raise ValueError("blob kzg commitments do not match payload transactions")
+
+
+def process_block(
+    cfg, state, epoch_ctx: EpochContext, block, verify_signatures: bool = True,
+    execution_engine=None,
+) -> None:
+    b0.process_block_header(cfg, state, epoch_ctx, block)
+    if bm.is_execution_enabled(state, block.body):
+        bc.process_withdrawals(cfg, state, block.body.execution_payload)
+        bm.process_execution_payload(cfg, state, block.body, execution_engine)
+    b0.process_randao(cfg, state, epoch_ctx, block.body, verify_signatures)
+    b0.process_eth1_data(cfg, state, block.body)
+    bc.process_operations(
+        cfg, state, epoch_ctx, block.body, verify_signatures,
+        deposit_fork=ForkName.eip4844,
+    )
+    ba.process_sync_aggregate(cfg, state, epoch_ctx, block, verify_signatures)
+    process_blob_kzg_commitments(cfg, state, block.body)

@@ -170,13 +170,22 @@ def slash_validator(
         v.withdrawable_epoch, epoch + _p.EPOCHS_PER_SLASHINGS_VECTOR
     )
     state.slashings[epoch % _p.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
+    # fork-dependent quotients (altair/bellatrix "Modified slash_validator")
+    from lodestar_tpu.params import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR, ForkName
+    from lodestar_tpu.types import fork_of_state
+    from ..fork_params import min_slashing_penalty_quotient
+
+    fork = fork_of_state(state)
     decrease_balance(
-        state, index, v.effective_balance // _p.MIN_SLASHING_PENALTY_QUOTIENT
+        state, index, v.effective_balance // min_slashing_penalty_quotient(fork)
     )
     proposer_index = epoch_ctx.get_beacon_proposer(state.slot)
     whistleblower_index = whistleblower if whistleblower is not None else proposer_index
     whistleblower_reward = v.effective_balance // _p.WHISTLEBLOWER_REWARD_QUOTIENT
-    proposer_reward = whistleblower_reward // _p.PROPOSER_REWARD_QUOTIENT
+    if fork is ForkName.phase0:
+        proposer_reward = whistleblower_reward // _p.PROPOSER_REWARD_QUOTIENT
+    else:
+        proposer_reward = whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
     increase_balance(state, proposer_index, proposer_reward)
     increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)
 

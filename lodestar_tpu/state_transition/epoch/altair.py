@@ -184,7 +184,12 @@ def get_flag_deltas(cfg, state, proc: AltairEpochProcess):
         & ((proc.prev_participation & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0)
     )
     mask = proc.eligible & ~prev_target
-    penalty_den = cfg.INACTIVITY_SCORE_BIAS * _p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    from lodestar_tpu.types import fork_of_state
+    from ..fork_params import inactivity_penalty_quotient
+
+    penalty_den = cfg.INACTIVITY_SCORE_BIAS * inactivity_penalty_quotient(
+        fork_of_state(state)
+    )
     penalties[mask] += (
         proc.effective_balances[mask] * scores[mask] // penalty_den
     )
@@ -203,11 +208,15 @@ def process_rewards_and_penalties(cfg, state, proc: AltairEpochProcess) -> None:
 
 
 def process_slashings(cfg, state, proc: AltairEpochProcess) -> None:
+    from lodestar_tpu.types import fork_of_state
+    from ..fork_params import proportional_slashing_multiplier
+
     epoch = proc.current_epoch
     total_balance = proc.total_active_balance
     total_slashings = sum(state.slashings)
     mult = min(
-        total_slashings * _p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR, total_balance
+        total_slashings * proportional_slashing_multiplier(fork_of_state(state)),
+        total_balance,
     )
     for i, v in enumerate(state.validators):
         if (
@@ -240,7 +249,26 @@ def process_sync_committee_updates(cfg, state, proc, epoch_ctx: EpochContext) ->
             del epoch_ctx._sync_committee_indices
 
 
+def process_historical_summaries_update(cfg, state, proc) -> None:
+    """Capella replacement for historical_roots accumulation: append a
+    HistoricalSummary of the two root vectors (consensus-specs capella
+    beacon-chain.md process_historical_summaries_update)."""
+    next_epoch = proc.current_epoch + 1
+    if next_epoch % (_p.SLOTS_PER_HISTORICAL_ROOT // _p.SLOTS_PER_EPOCH) == 0:
+        roots_t = ssz.capella.BeaconState._fields_["block_roots"]
+        state.historical_summaries.append(
+            ssz.capella.HistoricalSummary(
+                block_summary_root=roots_t.hash_tree_root(list(state.block_roots)),
+                state_summary_root=roots_t.hash_tree_root(list(state.state_roots)),
+            )
+        )
+
+
 def process_epoch(cfg, state, epoch_ctx: EpochContext) -> AltairEpochProcess:
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.types import fork_of_state
+    from ..fork_params import is_post_fork
+
     proc = before_process_epoch(cfg, state, epoch_ctx)
     process_justification_and_finalization(cfg, state, proc)
     process_inactivity_updates(cfg, state, proc)
@@ -251,7 +279,10 @@ def process_epoch(cfg, state, epoch_ctx: EpochContext) -> AltairEpochProcess:
     e0.process_effective_balance_updates(cfg, state, proc)
     e0.process_slashings_reset(cfg, state, proc)
     e0.process_randao_mixes_reset(cfg, state, proc)
-    e0.process_historical_roots_update(cfg, state, proc)
+    if is_post_fork(fork_of_state(state), ForkName.capella):
+        process_historical_summaries_update(cfg, state, proc)
+    else:
+        e0.process_historical_roots_update(cfg, state, proc)
     process_participation_flag_updates(cfg, state, proc)
     process_sync_committee_updates(cfg, state, proc, epoch_ctx)
     return proc

@@ -1,0 +1,35 @@
+"""Per-fork penalty/reward constants (consensus-specs altair & bellatrix
+beacon-chain.md "Updated ... quotients"; reference keeps these switches
+inline in state-transition/src/{block/slashValidator.ts,epoch/*}).
+"""
+from __future__ import annotations
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p, FORK_SEQ, ForkName
+
+
+def min_slashing_penalty_quotient(fork: ForkName) -> int:
+    if fork is ForkName.phase0:
+        return _p.MIN_SLASHING_PENALTY_QUOTIENT
+    if fork is ForkName.altair:
+        return _p.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    return _p.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+
+
+def proportional_slashing_multiplier(fork: ForkName) -> int:
+    if fork is ForkName.phase0:
+        return _p.PROPORTIONAL_SLASHING_MULTIPLIER
+    if fork is ForkName.altair:
+        return _p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    return _p.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+
+
+def inactivity_penalty_quotient(fork: ForkName) -> int:
+    if fork is ForkName.phase0:
+        return _p.INACTIVITY_PENALTY_QUOTIENT
+    if fork is ForkName.altair:
+        return _p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    return _p.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+
+
+def is_post_fork(fork: ForkName, base: ForkName) -> bool:
+    return FORK_SEQ[fork] >= FORK_SEQ[base]

@@ -133,6 +133,13 @@ def get_block_signature_sets(
         s = get_sync_aggregate_signature_set(cfg, state, epoch_ctx, block)
         if s is not None:
             sets.append(s)
+    if hasattr(block.body, "bls_to_execution_changes"):
+        from .block.capella import get_bls_to_execution_change_signature_set
+
+        for chg in block.body.bls_to_execution_changes:
+            sets.append(
+                get_bls_to_execution_change_signature_set(cfg, state, chg)
+            )
     # deposits carry their own proof-of-possession checked inline
     # (processDeposit) because the pubkey may be brand new — same as the
     # reference (signatureSets/index.ts comment).

@@ -13,16 +13,27 @@ from typing import Optional, Tuple
 
 from lodestar_tpu.params import ACTIVE_PRESET as _p, FORK_SEQ, ForkName
 from lodestar_tpu.types import fork_of_block, fork_of_state, ssz, types_for
-from .block import altair as block_altair, phase0 as block_phase0
+from .block import (
+    altair as block_altair,
+    bellatrix as block_bellatrix,
+    capella as block_capella,
+    eip4844 as block_eip4844,
+    phase0 as block_phase0,
+)
 from .epoch import altair as epoch_altair, phase0 as epoch_phase0
 from .epoch_context import EpochContext
 from .util.misc import compute_epoch_at_slot
 
 # per-fork processor dispatch (the reference's allForks indirection,
-# state-transition/src/stateTransition.ts processBlock/processEpoch switch)
+# state-transition/src/stateTransition.ts processBlock/processEpoch switch).
+# The altair epoch module is fork-aware from altair onward (quotients +
+# historical-summaries switch keyed on the state's fork).
 _PROCESSORS = {
     ForkName.phase0: (block_phase0, epoch_phase0),
     ForkName.altair: (block_altair, epoch_altair),
+    ForkName.bellatrix: (block_bellatrix, epoch_altair),
+    ForkName.capella: (block_capella, epoch_altair),
+    ForkName.eip4844: (block_eip4844, epoch_altair),
 }
 
 
@@ -78,19 +89,23 @@ def process_slots(cached: CachedBeaconState, slot: int) -> None:
             epoch_mod.process_epoch(cached.cfg, state, cached.epoch_ctx)
             state.slot += 1
             cached.epoch_ctx.rotate(state)
-            # fork upgrade at the boundary (stateTransition.ts processSlots
-            # upgrade hook)
+            # fork upgrades at the boundary (stateTransition.ts processSlots
+            # upgrade hooks) — applied in order so chained fork epochs work
             next_epoch = compute_epoch_at_slot(state.slot)
-            if (
-                fork_of_state(state) is ForkName.phase0
-                and next_epoch == cached.cfg.ALTAIR_FORK_EPOCH
-            ):
-                from .upgrade import upgrade_to_altair
+            from . import upgrade as upg
 
-                cached.state = upgrade_to_altair(
-                    cached.cfg, state, cached.epoch_ctx
-                )
-                state = cached.state
+            for fork, epoch_attr, fn in (
+                (ForkName.phase0, "ALTAIR_FORK_EPOCH", upg.upgrade_to_altair),
+                (ForkName.altair, "BELLATRIX_FORK_EPOCH", upg.upgrade_to_bellatrix),
+                (ForkName.bellatrix, "CAPELLA_FORK_EPOCH", upg.upgrade_to_capella),
+                (ForkName.capella, "EIP4844_FORK_EPOCH", upg.upgrade_to_eip4844),
+            ):
+                if (
+                    fork_of_state(state) is fork
+                    and next_epoch == getattr(cached.cfg, epoch_attr)
+                ):
+                    cached.state = fn(cached.cfg, state, cached.epoch_ctx)
+                    state = cached.state
         else:
             state.slot += 1
 

@@ -1,6 +1,7 @@
 """Fork upgrades (reference:
-packages/state-transition/src/slot/upgradeStateToAltair.ts; consensus-specs
-altair/fork.md upgrade_to_altair).
+packages/state-transition/src/slot/upgradeStateTo{Altair,Bellatrix,
+Capella,Eip4844}.ts; consensus-specs {altair,bellatrix,capella,eip4844}/
+fork.md upgrade functions).
 """
 from __future__ import annotations
 
@@ -82,4 +83,117 @@ def upgrade_to_altair(cfg, state, epoch_ctx: EpochContext):
     )
     post.current_sync_committee = committee
     post.next_sync_committee = committee
+    return post
+
+
+def _copy_shared_fields(post, state) -> None:
+    """Copy the altair-and-later field prefix shared by every post-altair
+    state shape (all upgrades from bellatrix on are pure field adds)."""
+    post.genesis_time = state.genesis_time
+    post.genesis_validators_root = bytes(state.genesis_validators_root)
+    post.slot = state.slot
+    post.latest_block_header = state.latest_block_header
+    post.block_roots = list(state.block_roots)
+    post.state_roots = list(state.state_roots)
+    post.historical_roots = list(state.historical_roots)
+    post.eth1_data = state.eth1_data
+    post.eth1_data_votes = list(state.eth1_data_votes)
+    post.eth1_deposit_index = state.eth1_deposit_index
+    post.validators = list(state.validators)
+    post.balances = list(state.balances)
+    post.randao_mixes = list(state.randao_mixes)
+    post.slashings = list(state.slashings)
+    post.previous_epoch_participation = list(state.previous_epoch_participation)
+    post.current_epoch_participation = list(state.current_epoch_participation)
+    post.justification_bits = list(state.justification_bits)
+    post.previous_justified_checkpoint = state.previous_justified_checkpoint
+    post.current_justified_checkpoint = state.current_justified_checkpoint
+    post.finalized_checkpoint = state.finalized_checkpoint
+    post.inactivity_scores = list(state.inactivity_scores)
+    post.current_sync_committee = state.current_sync_committee
+    post.next_sync_committee = state.next_sync_committee
+
+
+def upgrade_to_bellatrix(cfg, state, epoch_ctx: EpochContext):
+    """altair BeaconState -> bellatrix at the fork boundary: adds a default
+    (pre-merge) latest_execution_payload_header."""
+    epoch = compute_epoch_at_slot(state.slot)
+    post = ssz.bellatrix.BeaconState()
+    _copy_shared_fields(post, state)
+    post.fork = ssz.phase0.Fork(
+        previous_version=bytes(state.fork.current_version),
+        current_version=cfg.BELLATRIX_FORK_VERSION,
+        epoch=epoch,
+    )
+    post.latest_execution_payload_header = ssz.bellatrix.ExecutionPayloadHeader.default()
+    return post
+
+
+def upgrade_to_capella(cfg, state, epoch_ctx: EpochContext):
+    """bellatrix -> capella: header gains withdrawals_root, state gains the
+    withdrawal sweep cursors + empty historical_summaries."""
+    epoch = compute_epoch_at_slot(state.slot)
+    pre_h = state.latest_execution_payload_header
+    post = ssz.capella.BeaconState()
+    _copy_shared_fields(post, state)
+    post.fork = ssz.phase0.Fork(
+        previous_version=bytes(state.fork.current_version),
+        current_version=cfg.CAPELLA_FORK_VERSION,
+        epoch=epoch,
+    )
+    post.latest_execution_payload_header = ssz.capella.ExecutionPayloadHeader(
+        parent_hash=bytes(pre_h.parent_hash),
+        fee_recipient=bytes(pre_h.fee_recipient),
+        state_root=bytes(pre_h.state_root),
+        receipts_root=bytes(pre_h.receipts_root),
+        logs_bloom=bytes(pre_h.logs_bloom),
+        prev_randao=bytes(pre_h.prev_randao),
+        block_number=pre_h.block_number,
+        gas_limit=pre_h.gas_limit,
+        gas_used=pre_h.gas_used,
+        timestamp=pre_h.timestamp,
+        extra_data=bytes(pre_h.extra_data),
+        base_fee_per_gas=pre_h.base_fee_per_gas,
+        block_hash=bytes(pre_h.block_hash),
+        transactions_root=bytes(pre_h.transactions_root),
+        withdrawals_root=b"\x00" * 32,
+    )
+    post.next_withdrawal_index = 0
+    post.next_withdrawal_validator_index = 0
+    post.historical_summaries = []
+    return post
+
+
+def upgrade_to_eip4844(cfg, state, epoch_ctx: EpochContext):
+    """capella -> eip4844: header gains excess_data_gas."""
+    epoch = compute_epoch_at_slot(state.slot)
+    pre_h = state.latest_execution_payload_header
+    post = ssz.eip4844.BeaconState()
+    _copy_shared_fields(post, state)
+    post.fork = ssz.phase0.Fork(
+        previous_version=bytes(state.fork.current_version),
+        current_version=cfg.EIP4844_FORK_VERSION,
+        epoch=epoch,
+    )
+    post.latest_execution_payload_header = ssz.eip4844.ExecutionPayloadHeader(
+        parent_hash=bytes(pre_h.parent_hash),
+        fee_recipient=bytes(pre_h.fee_recipient),
+        state_root=bytes(pre_h.state_root),
+        receipts_root=bytes(pre_h.receipts_root),
+        logs_bloom=bytes(pre_h.logs_bloom),
+        prev_randao=bytes(pre_h.prev_randao),
+        block_number=pre_h.block_number,
+        gas_limit=pre_h.gas_limit,
+        gas_used=pre_h.gas_used,
+        timestamp=pre_h.timestamp,
+        extra_data=bytes(pre_h.extra_data),
+        base_fee_per_gas=pre_h.base_fee_per_gas,
+        excess_data_gas=0,
+        block_hash=bytes(pre_h.block_hash),
+        transactions_root=bytes(pre_h.transactions_root),
+        withdrawals_root=bytes(pre_h.withdrawals_root),
+    )
+    post.next_withdrawal_index = state.next_withdrawal_index
+    post.next_withdrawal_validator_index = state.next_withdrawal_validator_index
+    post.historical_summaries = list(state.historical_summaries)
     return post

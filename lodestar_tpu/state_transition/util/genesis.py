@@ -163,12 +163,28 @@ def init_dev_state(
     )
     if genesis_time is not None:
         state.genesis_time = genesis_time
+    # fork-at-genesis dev nets: upgrade the phase0 genesis in place through
+    # every fork scheduled at epoch 0 (the reference's getGenesisBeaconState
+    # upgrades per fork schedule)
     if cfg.ALTAIR_FORK_EPOCH == 0:
-        # altair-from-genesis dev nets: upgrade the phase0 genesis in place
-        # (the reference's getGenesisBeaconState upgrades per fork schedule)
         from ..epoch_context import EpochContext
-        from ..upgrade import upgrade_to_altair
+        from .. import upgrade as upg
 
-        state = upgrade_to_altair(cfg, state, EpochContext(state))
+        state = upg.upgrade_to_altair(cfg, state, EpochContext(state))
         state.fork.previous_version = cfg.GENESIS_FORK_VERSION
+        if cfg.BELLATRIX_FORK_EPOCH == 0:
+            state = upg.upgrade_to_bellatrix(cfg, state, None)
+            state.fork.previous_version = cfg.GENESIS_FORK_VERSION
+            # post-merge-from-genesis: a non-default genesis execution
+            # header so is_merge_transition_complete is true from slot 0
+            # (reference node/utils/interop/state.ts executionPayloadHeader)
+            state.latest_execution_payload_header.block_hash = eth1_block_hash
+            state.latest_execution_payload_header.timestamp = state.genesis_time
+            state.latest_execution_payload_header.prev_randao = eth1_block_hash
+            if cfg.CAPELLA_FORK_EPOCH == 0:
+                state = upg.upgrade_to_capella(cfg, state, None)
+                state.fork.previous_version = cfg.GENESIS_FORK_VERSION
+                if cfg.EIP4844_FORK_EPOCH == 0:
+                    state = upg.upgrade_to_eip4844(cfg, state, None)
+                    state.fork.previous_version = cfg.GENESIS_FORK_VERSION
     return deposits, state

@@ -1,8 +1,8 @@
 """SSZ types per fork — the rebuild's `@lodestar/types`.
 
-`ssz.phase0` / `ssz.altair` namespaces mirror packages/types/src/sszTypes.ts.
+`ssz.phase0` … `ssz.eip4844` namespaces mirror packages/types/src/sszTypes.ts.
 """
-from . import altair, phase0
+from . import altair, bellatrix, capella, eip4844, phase0
 
 
 class _Namespace:
@@ -16,6 +16,9 @@ class _Namespace:
 class _Ssz:
     phase0 = _Namespace(phase0)
     altair = _Namespace(altair)
+    bellatrix = _Namespace(bellatrix)
+    capella = _Namespace(capella)
+    eip4844 = _Namespace(eip4844)
 
 
 ssz = _Ssz()
@@ -28,18 +31,30 @@ from lodestar_tpu.params import ForkName  # noqa: E402
 _STATE_TYPES = {
     ForkName.phase0: phase0.BeaconState,
     ForkName.altair: altair.BeaconState,
+    ForkName.bellatrix: bellatrix.BeaconState,
+    ForkName.capella: capella.BeaconState,
+    ForkName.eip4844: eip4844.BeaconState,
 }
 _BLOCK_TYPES = {
     ForkName.phase0: phase0.BeaconBlock,
     ForkName.altair: altair.BeaconBlock,
+    ForkName.bellatrix: bellatrix.BeaconBlock,
+    ForkName.capella: capella.BeaconBlock,
+    ForkName.eip4844: eip4844.BeaconBlock,
 }
 _SIGNED_BLOCK_TYPES = {
     ForkName.phase0: phase0.SignedBeaconBlock,
     ForkName.altair: altair.SignedBeaconBlock,
+    ForkName.bellatrix: bellatrix.SignedBeaconBlock,
+    ForkName.capella: capella.SignedBeaconBlock,
+    ForkName.eip4844: eip4844.SignedBeaconBlock,
 }
 _BODY_TYPES = {
     ForkName.phase0: phase0.BeaconBlockBody,
     ForkName.altair: altair.BeaconBlockBody,
+    ForkName.bellatrix: bellatrix.BeaconBlockBody,
+    ForkName.capella: capella.BeaconBlockBody,
+    ForkName.eip4844: eip4844.BeaconBlockBody,
 }
 
 
@@ -79,24 +94,32 @@ class SignedBlockSlotCodec:
     signature | message...], so the message's leading slot uint64 always
     sits at bytes 100..108 regardless of fork.
 
-    Must be `configure(cfg)`-ed with the chain config before altair blocks
-    can be decoded; unconfigured it decodes everything as phase0."""
+    Must be `configure(cfg)`-ed with the chain config before post-phase0
+    blocks can be decoded; unconfigured it decodes everything as phase0."""
 
     def __init__(self):
-        self._altair_epoch = None
+        self._fork_epochs = None  # [(epoch, ForkName)] ascending
 
     def configure(self, cfg) -> None:
-        self._altair_epoch = cfg.ALTAIR_FORK_EPOCH
+        self._fork_epochs = [
+            (0, ForkName.phase0),
+            (cfg.ALTAIR_FORK_EPOCH, ForkName.altair),
+            (cfg.BELLATRIX_FORK_EPOCH, ForkName.bellatrix),
+            (cfg.CAPELLA_FORK_EPOCH, ForkName.capella),
+            (cfg.EIP4844_FORK_EPOCH, ForkName.eip4844),
+        ]
 
     def fork_at_slot(self, slot: int) -> ForkName:
         from lodestar_tpu.params import ACTIVE_PRESET as _p
 
-        if (
-            self._altair_epoch is not None
-            and slot // _p.SLOTS_PER_EPOCH >= self._altair_epoch
-        ):
-            return ForkName.altair
-        return ForkName.phase0
+        if self._fork_epochs is None:
+            return ForkName.phase0
+        epoch = slot // _p.SLOTS_PER_EPOCH
+        out = ForkName.phase0
+        for fork_epoch, name in self._fork_epochs:
+            if fork_epoch <= epoch:
+                out = name
+        return out
 
     def serialize(self, sb) -> bytes:
         return type(sb).serialize(sb)

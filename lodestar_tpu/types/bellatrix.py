@@ -1,0 +1,191 @@
+"""Bellatrix (merge) SSZ container types
+(reference: packages/types/src/bellatrix/sszTypes.ts).
+
+Adds the ExecutionPayload / ExecutionPayloadHeader pair and threads the
+payload through BeaconBlockBody and BeaconState.
+"""
+from lodestar_tpu.params import ACTIVE_PRESET as _p, JUSTIFICATION_BITS_LENGTH
+from lodestar_tpu.ssz.core import (
+    Bitvector,
+    ByteList,
+    Bytes20,
+    Bytes32,
+    Container,
+    List,
+    Vector,
+    uint8,
+    uint64,
+    uint256,
+)
+from . import altair, phase0
+
+ExecutionAddress = Bytes20
+Transaction = ByteList[_p.MAX_BYTES_PER_TRANSACTION]
+Transactions = List[Transaction, _p.MAX_TRANSACTIONS_PER_PAYLOAD]
+
+
+class ExecutionPayload(Container):
+    parent_hash: Bytes32
+    fee_recipient: ExecutionAddress
+    state_root: Bytes32
+    receipts_root: Bytes32
+    logs_bloom: ByteList[_p.BYTES_PER_LOGS_BLOOM]
+    prev_randao: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[_p.MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    block_hash: Bytes32
+    transactions: Transactions
+
+
+class ExecutionPayloadHeader(Container):
+    parent_hash: Bytes32
+    fee_recipient: ExecutionAddress
+    state_root: Bytes32
+    receipts_root: Bytes32
+    logs_bloom: ByteList[_p.BYTES_PER_LOGS_BLOOM]
+    prev_randao: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[_p.MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    block_hash: Bytes32
+    transactions_root: phase0.Root
+
+
+def payload_to_header(payload: ExecutionPayload) -> ExecutionPayloadHeader:
+    """executionPayloadToPayloadHeader (reference
+    state-transition/src/util/execution.ts role for bellatrix)."""
+    return ExecutionPayloadHeader(
+        parent_hash=bytes(payload.parent_hash),
+        fee_recipient=bytes(payload.fee_recipient),
+        state_root=bytes(payload.state_root),
+        receipts_root=bytes(payload.receipts_root),
+        logs_bloom=bytes(payload.logs_bloom),
+        prev_randao=bytes(payload.prev_randao),
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=bytes(payload.extra_data),
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=bytes(payload.block_hash),
+        transactions_root=Transactions.hash_tree_root(list(payload.transactions)),
+    )
+
+
+class PowBlock(Container):
+    block_hash: phase0.Root
+    parent_hash: phase0.Root
+    total_difficulty: uint256
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: phase0.BLSSignature
+    eth1_data: phase0.Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[phase0.ProposerSlashing, _p.MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[phase0.AttesterSlashing, _p.MAX_ATTESTER_SLASHINGS]
+    attestations: List[phase0.Attestation, _p.MAX_ATTESTATIONS]
+    deposits: List[phase0.Deposit, _p.MAX_DEPOSITS]
+    voluntary_exits: List[phase0.SignedVoluntaryExit, _p.MAX_VOLUNTARY_EXITS]
+    sync_aggregate: altair.SyncAggregate
+    execution_payload: ExecutionPayload
+
+
+class BeaconBlock(Container):
+    slot: phase0.Slot
+    proposer_index: phase0.ValidatorIndex
+    parent_root: phase0.Root
+    state_root: phase0.Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: phase0.BLSSignature
+
+
+# blinded flow (MEV builder API)
+
+
+class BlindedBeaconBlockBody(Container):
+    randao_reveal: phase0.BLSSignature
+    eth1_data: phase0.Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[phase0.ProposerSlashing, _p.MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[phase0.AttesterSlashing, _p.MAX_ATTESTER_SLASHINGS]
+    attestations: List[phase0.Attestation, _p.MAX_ATTESTATIONS]
+    deposits: List[phase0.Deposit, _p.MAX_DEPOSITS]
+    voluntary_exits: List[phase0.SignedVoluntaryExit, _p.MAX_VOLUNTARY_EXITS]
+    sync_aggregate: altair.SyncAggregate
+    execution_payload_header: ExecutionPayloadHeader
+
+
+class BlindedBeaconBlock(Container):
+    slot: phase0.Slot
+    proposer_index: phase0.ValidatorIndex
+    parent_root: phase0.Root
+    state_root: phase0.Root
+    body: BlindedBeaconBlockBody
+
+
+class SignedBlindedBeaconBlock(Container):
+    message: BlindedBeaconBlock
+    signature: phase0.BLSSignature
+
+
+class ValidatorRegistrationV1(Container):
+    fee_recipient: ExecutionAddress
+    gas_limit: uint64
+    timestamp: uint64
+    pubkey: phase0.BLSPubkey
+
+
+class SignedValidatorRegistrationV1(Container):
+    message: ValidatorRegistrationV1
+    signature: phase0.BLSSignature
+
+
+class BuilderBid(Container):
+    header: ExecutionPayloadHeader
+    value: uint256
+    pubkey: phase0.BLSPubkey
+
+
+class SignedBuilderBid(Container):
+    message: BuilderBid
+    signature: phase0.BLSSignature
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: phase0.Root
+    slot: phase0.Slot
+    fork: phase0.Fork
+    latest_block_header: phase0.BeaconBlockHeader
+    block_roots: Vector[phase0.Root, _p.SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[phase0.Root, _p.SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[phase0.Root, _p.HISTORICAL_ROOTS_LIMIT]
+    eth1_data: phase0.Eth1Data
+    eth1_data_votes: phase0.Eth1DataVotes
+    eth1_deposit_index: uint64
+    validators: List[phase0.Validator, _p.VALIDATOR_REGISTRY_LIMIT]
+    balances: List[phase0.Gwei, _p.VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, _p.EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[phase0.Gwei, _p.EPOCHS_PER_SLASHINGS_VECTOR]
+    previous_epoch_participation: altair.EpochParticipation
+    current_epoch_participation: altair.EpochParticipation
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: phase0.Checkpoint
+    current_justified_checkpoint: phase0.Checkpoint
+    finalized_checkpoint: phase0.Checkpoint
+    inactivity_scores: List[uint64, _p.VALIDATOR_REGISTRY_LIMIT]
+    current_sync_committee: altair.SyncCommittee
+    next_sync_committee: altair.SyncCommittee
+    latest_execution_payload_header: ExecutionPayloadHeader

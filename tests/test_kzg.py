@@ -1,0 +1,124 @@
+"""KZG polynomial commitments (crypto/kzg.py — the c-kzg role, reference
+packages/beacon-node/src/util/kzg.ts; spec eip4844
+polynomial-commitments.md).  Runs on the minimal preset's 4-element blobs
+with the insecure dev trusted setup.
+"""
+import pytest
+
+from lodestar_tpu.crypto import kzg
+from lodestar_tpu.crypto.bls.fields import R
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+
+def _blob(seed: int) -> bytes:
+    poly = [(seed * 31 + j * 7 + 1) % R for j in range(_p.FIELD_ELEMENTS_PER_BLOB)]
+    return kzg.polynomial_to_blob(poly)
+
+
+def test_roots_of_unity():
+    n = _p.FIELD_ELEMENTS_PER_BLOB
+    dom = kzg.roots_of_unity_brp(n)
+    assert len(set(dom)) == n
+    for w in dom:
+        assert pow(w, n, R) == 1
+
+
+def test_field_encoding_canonical():
+    assert kzg.bytes_to_bls_field(kzg.bls_field_to_bytes(12345)) == 12345
+    with pytest.raises(kzg.KzgError):
+        kzg.bytes_to_bls_field((R).to_bytes(32, "little"))
+
+
+def test_barycentric_matches_direct_eval():
+    # blob evaluation form = values at the bit-reversed domain; interpolate
+    # and compare against barycentric evaluation at an off-domain point
+    n = _p.FIELD_ELEMENTS_PER_BLOB
+    dom = kzg.roots_of_unity_brp(n)
+    poly_eval = [(3 * j + 2) % R for j in range(n)]
+    z = 987654321
+
+    # Lagrange interpolation at z from the (domain, value) pairs
+    want = 0
+    for i, (wi, yi) in enumerate(zip(dom, poly_eval)):
+        num, den = 1, 1
+        for j, wj in enumerate(dom):
+            if i == j:
+                continue
+            num = num * ((z - wj) % R) % R
+            den = den * ((wi - wj) % R) % R
+        want = (want + yi * num % R * pow(den, R - 2, R)) % R
+    got = kzg.evaluate_polynomial_in_evaluation_form(poly_eval, z)
+    assert got == want
+    # domain point short-circuits to the stored value
+    assert kzg.evaluate_polynomial_in_evaluation_form(poly_eval, dom[2]) == poly_eval[2]
+
+
+def test_single_proof_roundtrip():
+    blob = _blob(1)
+    comm = kzg.blob_to_kzg_commitment(blob)
+    z = 5555
+    proof, y = kzg.compute_kzg_proof(blob, z)
+    assert kzg.verify_kzg_proof(comm, z, y, proof)
+    assert not kzg.verify_kzg_proof(comm, z, (y + 1) % R, proof)
+    assert not kzg.verify_kzg_proof(comm, z + 1, y, proof)
+
+
+def test_proof_at_domain_point():
+    blob = _blob(2)
+    comm = kzg.blob_to_kzg_commitment(blob)
+    dom = kzg.roots_of_unity_brp(_p.FIELD_ELEMENTS_PER_BLOB)
+    proof, y = kzg.compute_kzg_proof(blob, dom[1])
+    assert y == kzg.blob_to_polynomial(blob)[1]
+    assert kzg.verify_kzg_proof(comm, dom[1], y, proof)
+
+
+def test_aggregate_proof_roundtrip():
+    blobs = [_blob(i) for i in range(3)]
+    comms = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+    proof = kzg.compute_aggregate_kzg_proof(blobs)
+    assert kzg.verify_aggregate_kzg_proof(blobs, comms, proof)
+    # any corruption breaks it
+    bad = bytearray(blobs[0])
+    bad[0] ^= 1
+    assert not kzg.verify_aggregate_kzg_proof([bytes(bad)] + blobs[1:], comms, proof)
+    assert not kzg.verify_aggregate_kzg_proof(blobs, list(reversed(comms)), proof)
+    assert not kzg.verify_aggregate_kzg_proof(blobs, comms[:-1], proof)
+
+
+def test_empty_aggregate():
+    proof = kzg.compute_aggregate_kzg_proof([])
+    assert kzg.verify_aggregate_kzg_proof([], [], proof)
+    assert not kzg.verify_aggregate_kzg_proof([], [], b"\x01" * 48)
+
+
+def test_blobs_sidecar_validation_roundtrip():
+    from lodestar_tpu.chain.blobs import build_blobs_sidecar, empty_blobs_sidecar
+    from lodestar_tpu.chain.validation import (
+        GossipValidationError,
+        validate_blobs_sidecar,
+    )
+
+    blobs = [_blob(i) for i in range(2)]
+    comms = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+    root = b"\x11" * 32
+    sc = build_blobs_sidecar(root, 7, blobs)
+    validate_blobs_sidecar(7, root, comms, sc)  # no raise
+    with pytest.raises(GossipValidationError):
+        validate_blobs_sidecar(8, root, comms, sc)
+    with pytest.raises(GossipValidationError):
+        validate_blobs_sidecar(7, b"\x22" * 32, comms, sc)
+    with pytest.raises(GossipValidationError):
+        validate_blobs_sidecar(7, root, list(reversed(comms)), sc)
+    empty = empty_blobs_sidecar(root, 7)
+    validate_blobs_sidecar(7, root, [], empty)
+
+
+def test_blobs_sidecar_db_roundtrip():
+    from lodestar_tpu.chain.blobs import build_blobs_sidecar
+    from lodestar_tpu.db.beacon import BeaconDb
+
+    db = BeaconDb()
+    sc = build_blobs_sidecar(b"\x33" * 32, 5, [_blob(0)])
+    root = db.blobs_sidecar.add(sc)
+    assert root == b"\x33" * 32
+    assert db.blobs_sidecar.get(root) == sc
