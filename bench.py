@@ -102,26 +102,34 @@ def _child_main(batch: int, iters: int) -> None:
     print(json.dumps(run_config(batch, iters)), flush=True)
 
 
+_live_child = {"proc": None}
+
+
 def _run_stage(batch: int, iters: int, timeout_s: float) -> dict | None:
     """Run one config in a subprocess under its own wall-clock cap."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child", str(batch), str(iters)]
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=sys.stderr,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    _live_child["proc"] = proc
     try:
-        proc = subprocess.run(
-            cmd,
-            stdout=subprocess.PIPE,
-            stderr=sys.stderr,
-            timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
+        out, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        print(f"bench: stage B={batch} exceeded {timeout_s:.0f}s, trying smaller",
+        proc.kill()
+        proc.communicate()
+        print(f"bench: stage B={batch} exceeded {timeout_s:.0f}s",
               file=sys.stderr, flush=True)
         return None
+    finally:
+        _live_child["proc"] = None
     if proc.returncode != 0:
         print(f"bench: stage B={batch} failed rc={proc.returncode}",
               file=sys.stderr, flush=True)
         return None
-    for line in proc.stdout.decode().splitlines():
+    for line in out.decode().splitlines():
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -131,37 +139,75 @@ def _run_stage(batch: int, iters: int, timeout_s: float) -> dict | None:
     return None
 
 
+_FALLBACK = {
+    "metric": "bls_batch_verify_sigs_per_sec_per_chip",
+    "value": 0.0,
+    "unit": "sigs/s",
+    "vs_baseline": 0.0,
+    "error": "no stage finished within budget (cold XLA compile)",
+}
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         _child_main(int(sys.argv[2]), int(sys.argv[3]))
         return
 
-    budget = float(os.environ.get("BENCH_BUDGET_S", "2100"))
+    # The driver kills this process at an UNKNOWN external timeout (via
+    # SIGTERM from `timeout`).  Print the best banked result the moment the
+    # signal lands so a partial run still reports real numbers, and also
+    # re-print after each completed stage (the driver parses the LAST JSON
+    # line).
+    import signal
+
+    state = {"best": None, "printed": None}
+
+    def _emit(result) -> None:
+        if result is not None and result != state["printed"]:
+            print(json.dumps(result), flush=True)
+            state["printed"] = result
+
+    def _on_term(signum, frame):
+        child = _live_child.get("proc")
+        if child is not None:
+            try:
+                child.kill()
+            except Exception:
+                pass
+        _emit(state["best"] or _FALLBACK)
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    # The driver's external timeout is unknown (round-2 kill arrived before
+    # a single cold stage finished), so: run SMALL first to bank a result
+    # fast, then climb to the throughput batches, keeping the best
+    # (highest sigs/s) stage that finished.  Total work is bounded by
+    # BENCH_BUDGET_S; each stage gets a cap so one stuck compile cannot
+    # starve the rest.
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     deadline = time.time() + budget
-    result = None
-    # flagship first; fall back to smaller (cheaper-to-compile) batches.
-    # Each stage is capped below the remaining budget so a timed-out
-    # flagship still leaves room for the fallbacks to finish.
-    stages = (int(os.environ.get("BENCH_BATCH", "128")), 32, 8)
+    stages = (8, 128, int(os.environ.get("BENCH_BATCH_MAX", "512")))
     for i, batch in enumerate(stages):
         remaining = deadline - time.time()
         if remaining < 60:
             break
-        is_last = i == len(stages) - 1
-        cap = remaining if is_last else remaining * 0.6
+        if state["best"] is None:
+            cap = min(remaining, 420.0)
+        elif i == len(stages) - 1:
+            cap = remaining  # last stage: use everything left
+        else:
+            cap = remaining * 0.85
         result = _run_stage(batch, iters, cap)
-        if result is not None:
-            break
-    if result is None:
-        result = {
-            "metric": "bls_batch_verify_sigs_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "sigs/s",
-            "vs_baseline": 0.0,
-            "error": "no stage finished within budget (cold XLA compile)",
-        }
-    print(json.dumps(result))
+        if result is not None and (
+            state["best"] is None
+            or result.get("value", 0) > state["best"].get("value", 0)
+        ):
+            state["best"] = result
+            _emit(result)
+    _emit(state["best"] or _FALLBACK)
 
 
 if __name__ == "__main__":

@@ -57,6 +57,19 @@ class ApiClient:
         """Generic GET returning the route's `data` payload."""
         return (await self._get(path))["data"]
 
+    async def get_state_ssz(self, state_id: str = "finalized"):
+        """Download a full BeaconState (debug/getStateV2 SSZ route) — the
+        client side of weak-subjectivity checkpoint sync."""
+        from lodestar_tpu.db.beacon import _STATE_MF
+
+        ses = await self._ses()
+        async with ses.get(
+            self.base_url + f"/eth/v2/debug/beacon/states/{state_id}"
+        ) as resp:
+            if resp.status >= 400:
+                raise ApiError(resp.status, await resp.text())
+            return _STATE_MF.deserialize(await resp.read())
+
     async def get_genesis(self) -> dict:
         return (await self._get("/eth/v1/beacon/genesis"))["data"]
 

@@ -80,6 +80,10 @@ class BeaconRestApiServer:
         r.add_post("/eth/v1/beacon/pool/attestations", self.post_pool_attestations)
         r.add_post("/eth/v1/beacon/pool/voluntary_exits", self.post_pool_exit)
         r.add_post(
+            "/eth/v1/beacon/pool/bls_to_execution_changes",
+            self.post_pool_bls_to_execution_change,
+        )
+        r.add_post(
             "/eth/v1/beacon/pool/attester_slashings", self.post_pool_attester_slashing
         )
         r.add_post(
@@ -118,15 +122,24 @@ class BeaconRestApiServer:
         # events + debug
         r.add_get("/eth/v1/events", self.get_events)
         r.add_get("/eth/v1/debug/beacon/heads", self.get_debug_heads)
+        r.add_get(
+            "/eth/v2/debug/beacon/states/{state_id}", self.get_debug_state_ssz
+        )
 
     # ------------------------------------------------------------------
     # state helpers
     # ------------------------------------------------------------------
 
     def _resolve_state(self, state_id: str):
-        if state_id in ("head", "justified", "finalized"):
-            st = self.chain.get_head_state()
-            return st
+        if state_id == "head":
+            return self.chain.get_head_state()
+        if state_id in ("justified", "finalized"):
+            # the actual checkpoint state — a checkpoint-sync client
+            # anchoring on "finalized" must NOT receive the reorgable tip
+            cp = getattr(self.chain.fork_choice.store, state_id)
+            return self.chain.get_checkpoint_state(
+                cp.epoch, bytes.fromhex(cp.root[2:])
+            )
         if state_id.startswith("0x"):
             # by state root: search cache
             for root, cached in self.chain.state_cache._map.items():
@@ -351,9 +364,35 @@ class BeaconRestApiServer:
         return web.json_response({}, status=200)
 
     async def post_pool_exit(self, request):
+        from lodestar_tpu.chain.validation import (
+            GossipValidationError,
+            validate_gossip_voluntary_exit,
+        )
+
         body = await request.json()
         exit_ = from_json(ssz.phase0.SignedVoluntaryExit, body)
+        try:
+            await validate_gossip_voluntary_exit(self.chain, exit_)
+        except GossipValidationError as e:
+            return _err(400, str(e))
         self.chain.op_pool.add_voluntary_exit(exit_)
+        return web.json_response({}, status=200)
+
+    async def post_pool_bls_to_execution_change(self, request):
+        from lodestar_tpu.chain.validation import (
+            GossipValidationError,
+            validate_gossip_bls_to_execution_change,
+        )
+
+        body = await request.json()
+        items = body if isinstance(body, list) else [body]
+        for item in items:
+            chg = from_json(ssz.capella.SignedBLSToExecutionChange, item)
+            try:
+                await validate_gossip_bls_to_execution_change(self.chain, chg)
+            except GossipValidationError as e:
+                return _err(400, str(e))
+            self.chain.op_pool.add_bls_to_execution_change(chg)
         return web.json_response({}, status=200)
 
     # ------------------------------------------------------------------
@@ -552,6 +591,21 @@ class BeaconRestApiServer:
             body.sync_aggregate = self.chain.sync_contribution_pool.get_sync_aggregate(
                 slot, self.chain.head_root
             )
+        if hasattr(body, "bls_to_execution_changes"):
+            body.bls_to_execution_changes = (
+                self.chain.op_pool.get_bls_to_execution_changes(pre.state)
+            )
+        if hasattr(body, "execution_payload"):
+            from lodestar_tpu.state_transition.block.bellatrix import (
+                is_merge_transition_complete,
+            )
+
+            if is_merge_transition_complete(pre.state):
+                from lodestar_tpu.execution.engine import build_dev_payload
+
+                body.execution_payload = build_dev_payload(
+                    self.chain.cfg, pre.state
+                )
         hdr = head_state.state.latest_block_header
         parent_hdr = ssz.phase0.BeaconBlockHeader(
             slot=hdr.slot, proposer_index=hdr.proposer_index,
@@ -689,6 +743,20 @@ class BeaconRestApiServer:
         finally:
             self._event_queues.remove(entry)
         return resp
+
+    async def get_debug_state_ssz(self, request):
+        """Full state as fork-tagged SSZ bytes (debug/getStateV2 role) —
+        the trusted-node side of weak-subjectivity checkpoint sync
+        (fetchWeakSubjectivityState downloads exactly this)."""
+        st = self._resolve_state(request.match_info["state_id"])
+        if st is None:
+            return _err(404, "state not found")
+        from lodestar_tpu.db.beacon import _STATE_MF
+
+        return web.Response(
+            body=_STATE_MF.serialize(st.state),
+            content_type="application/octet-stream",
+        )
 
     async def get_debug_heads(self, request):
         heads = []

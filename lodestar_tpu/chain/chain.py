@@ -133,12 +133,14 @@ class BeaconChain:
         clock: Optional[LocalClock] = None,
         metrics=None,
         eth1=None,
+        merge_tracker=None,
     ):
         self.cfg = cfg
         self.db = db
         self.bls = verifier or SingleThreadBlsVerifier()
         self.execution_engine = execution_engine
         self.eth1 = eth1  # Eth1DepositDataTracker or None
+        self.merge_tracker = merge_tracker  # Eth1MergeBlockTracker or None
         self.metrics = metrics  # lodestar_tpu.metrics.Metrics or None
         anchor = CachedBeaconState(cfg, anchor_state)
         self.genesis_time = anchor_state.genesis_time
@@ -257,6 +259,25 @@ class BeaconChain:
         with the block root (chain.ts processBlock -> BlockProcessor)."""
         return await self.block_queue.push(signed_block)
 
+    async def process_block_and_blobs(self, pair) -> bytes:
+        """eip4844 import: validate the BlobsSidecar against the block's
+        commitments, import the block, then persist the sidecar keyed by
+        the block root (the reference's block-and-blobs import flow)."""
+        from .validation import validate_blobs_sidecar
+
+        signed_block = pair.beacon_block
+        block = signed_block.message
+        root = type(block).hash_tree_root(block)
+        validate_blobs_sidecar(
+            block.slot,
+            root,
+            list(block.body.blob_kzg_commitments),
+            pair.blobs_sidecar,
+        )
+        out = await self.process_block(signed_block)
+        self.db.blobs_sidecar.add(pair.blobs_sidecar)
+        return out
+
     async def _process_block_job(self, signed_block) -> bytes:
         block = signed_block.message
         root = type(block).hash_tree_root(block)
@@ -288,6 +309,21 @@ class BeaconChain:
             payload = getattr(block.body, "execution_payload", None)
             if payload is None:
                 return None
+            # spec validate_merge_block: the transition block's payload
+            # parent must be a valid terminal PoW block (verified through
+            # the merge tracker when one is attached — eth1MergeBlockTracker
+            # role, verifyBlocksExecutionPayloads.ts).
+            if self.merge_tracker is not None:
+                from lodestar_tpu.state_transition.block.bellatrix import (
+                    is_merge_transition_block,
+                )
+
+                if is_merge_transition_block(pre_state.state, block.body):
+                    ok = await self.merge_tracker.validate_merge_block(
+                        bytes(payload.parent_hash)
+                    )
+                    if not ok:
+                        raise ValueError("invalid terminal pow block")
             return await self.execution_engine.notify_new_payload(payload)
 
         def run_stf():

@@ -133,6 +133,23 @@ class OpPool:
         self.attester_slashings: Dict[bytes, "ssz.phase0.AttesterSlashing"] = {}
         self.proposer_slashings: Dict[int, "ssz.phase0.ProposerSlashing"] = {}
         self.voluntary_exits: Dict[int, "ssz.phase0.SignedVoluntaryExit"] = {}
+        # capella (opPool.ts blsToExecutionChanges)
+        self.bls_to_execution_changes: Dict[int, object] = {}
+
+    def add_bls_to_execution_change(self, c) -> None:
+        self.bls_to_execution_changes[c.message.validator_index] = c
+
+    def get_bls_to_execution_changes(self, state) -> list:
+        from lodestar_tpu.params import BLS_WITHDRAWAL_PREFIX
+
+        out = []
+        for c in self.bls_to_execution_changes.values():
+            idx = c.message.validator_index
+            if idx < len(state.validators) and bytes(
+                state.validators[idx].withdrawal_credentials
+            )[:1] == bytes([BLS_WITHDRAWAL_PREFIX]):
+                out.append(c)
+        return out[: _p.MAX_BLS_TO_EXECUTION_CHANGES]
 
     def add_attester_slashing(self, s) -> None:
         root = ssz.phase0.AttesterSlashing.hash_tree_root(s)

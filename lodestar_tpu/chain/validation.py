@@ -504,6 +504,107 @@ async def validate_gossip_block_and_blobs_sidecar(chain, pair) -> None:
 
 
 # ---------------------------------------------------------------------------
+# voluntary exit + slashings gossip (chain/validation/{voluntaryExit,
+# attesterSlashing,proposerSlashing}.ts roles; also run on REST pool
+# submission like the reference's api/impl/beacon/pool handlers)
+# ---------------------------------------------------------------------------
+
+
+async def validate_gossip_voluntary_exit(chain, signed_exit) -> None:
+    """Non-mutating preconditions of process_voluntary_exit + signature
+    through the batch verifier."""
+    from lodestar_tpu.params import FAR_FUTURE_EPOCH
+    from lodestar_tpu.state_transition.block.phase0 import is_active_validator
+    from lodestar_tpu.state_transition.signature_sets import (
+        get_voluntary_exit_signature_set,
+    )
+    from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+
+    exit_ = signed_exit.message
+    idx = int(exit_.validator_index)
+    if idx in chain.op_pool.voluntary_exits:
+        raise GossipValidationError(
+            GossipErrorCode.ATTESTER_ALREADY_SEEN, "exit already known"
+        )
+    st = chain.get_head_state().state
+    if idx >= len(st.validators):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "unknown validator")
+    v = st.validators[idx]
+    epoch = compute_epoch_at_slot(st.slot)
+    if (
+        not is_active_validator(v, epoch)
+        or v.exit_epoch != FAR_FUTURE_EPOCH
+        or epoch < exit_.epoch
+        or epoch < v.activation_epoch + chain.cfg.SHARD_COMMITTEE_PERIOD
+    ):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "exit preconditions")
+    sig_set = get_voluntary_exit_signature_set(chain.cfg, st, signed_exit)
+    if not await chain.bls.verify_signature_sets(
+        [sig_set], VerifyOptions(batchable=True)
+    ):
+        raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)
+
+
+async def validate_gossip_attester_slashing(chain, slashing) -> None:
+    from lodestar_tpu.state_transition.block.phase0 import (
+        is_slashable_attestation_data,
+        is_slashable_validator,
+    )
+    from lodestar_tpu.state_transition.signature_sets import (
+        get_attester_slashing_signature_sets,
+    )
+    from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    if not is_slashable_attestation_data(a1.data, a2.data):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "not slashable")
+    st = chain.get_head_state().state
+    epoch = compute_epoch_at_slot(st.slot)
+    common = set(int(i) for i in a1.attesting_indices) & set(
+        int(i) for i in a2.attesting_indices
+    )
+    if not any(
+        i < len(st.validators) and is_slashable_validator(st.validators[i], epoch)
+        for i in common
+    ):
+        raise GossipValidationError(
+            GossipErrorCode.INVALID_TARGET, "no slashable validators"
+        )
+    sets = get_attester_slashing_signature_sets(chain.cfg, st, slashing)
+    if not await chain.bls.verify_signature_sets(
+        sets, VerifyOptions(batchable=True)
+    ):
+        raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)
+
+
+async def validate_gossip_proposer_slashing(chain, slashing) -> None:
+    from lodestar_tpu.state_transition.block.phase0 import is_slashable_validator
+    from lodestar_tpu.state_transition.signature_sets import (
+        get_proposer_slashing_signature_sets,
+    )
+    from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+
+    h1, h2 = slashing.signed_header_1.message, slashing.signed_header_2.message
+    if (
+        h1.slot != h2.slot
+        or h1.proposer_index != h2.proposer_index
+        or h1 == h2
+    ):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "bad headers")
+    st = chain.get_head_state().state
+    idx = int(h1.proposer_index)
+    if idx >= len(st.validators) or not is_slashable_validator(
+        st.validators[idx], compute_epoch_at_slot(st.slot)
+    ):
+        raise GossipValidationError(GossipErrorCode.INVALID_TARGET, "not slashable")
+    sets = get_proposer_slashing_signature_sets(chain.cfg, st, slashing)
+    if not await chain.bls.verify_signature_sets(
+        sets, VerifyOptions(batchable=True)
+    ):
+        raise GossipValidationError(GossipErrorCode.INVALID_SIGNATURE)
+
+
+# ---------------------------------------------------------------------------
 # capella bls_to_execution_change gossip (chain/validation/
 # blsToExecutionChange.ts role)
 # ---------------------------------------------------------------------------

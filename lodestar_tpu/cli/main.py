@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
     beacon.add_argument("--checkpoint-state", type=str, default=None,
                         help="weak-subjectivity start: fork-tagged SSZ BeaconState file "
                              "(initBeaconState.ts checkpoint-sync role)")
+    beacon.add_argument("--checkpoint-sync-url", type=str, default=None,
+                        help="weak-subjectivity start: fetch the finalized state from "
+                             "another node's REST API (fetchWeakSubjectivityState role)")
     beacon.add_argument("--rest-port", type=int, default=9596)
     beacon.add_argument("--metrics-port", type=int, default=8008)
     beacon.add_argument("--verifier", choices=["oracle", "device"], default="oracle")
@@ -108,12 +111,46 @@ def build_parser() -> argparse.ArgumentParser:
     flare.add_argument("--beacon-url", type=str, default="http://127.0.0.1:9596")
     flare.add_argument("--index", type=int, required=True, help="interop validator index")
     flare.add_argument("--epoch", type=int, default=0)
+
+    # --param KEY=VALUE chain-config overrides on every subcommand
+    # (the reference's `--params.ALTAIR_FORK_EPOCH=0` yargs flags +
+    # config/chainConfig YAML loading, cli/src/options/paramsOptions.ts)
+    for p in sub.choices.values():
+        p.add_argument(
+            "--param",
+            action="append",
+            default=[],
+            metavar="KEY=VALUE",
+            help="chain config override, e.g. --param ALTAIR_FORK_EPOCH=0",
+        )
     return parser
+
+
+def resolve_chain_config(args):
+    """default_chain_config + any --param overrides."""
+    from lodestar_tpu.config import chain_config_from_dict, default_chain_config
+
+    overrides = {}
+    for kv in getattr(args, "param", []) or []:
+        if "=" not in kv:
+            raise SystemExit(f"--param expects KEY=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        overrides[k] = v
+    if not overrides:
+        return default_chain_config
+    import dataclasses
+
+    known = {f.name for f in dataclasses.fields(type(default_chain_config))}
+    unknown = set(overrides) - known
+    if unknown:
+        raise SystemExit(f"unknown --param key(s): {', '.join(sorted(unknown))}")
+    return chain_config_from_dict(overrides)
 
 
 def run_dev(args) -> int:
     from lodestar_tpu.chain.dev import DevChain
-    from lodestar_tpu.config import default_chain_config as cfg
+
+    cfg = resolve_chain_config(args)
     from lodestar_tpu.params import ACTIVE_PRESET_NAME, SLOTS_PER_EPOCH
     from lodestar_tpu.types import ssz
 
@@ -187,7 +224,7 @@ def run_beacon(args) -> int:
     from lodestar_tpu.chain.archiver import Archiver
     from lodestar_tpu.chain.chain import BeaconChain
     from lodestar_tpu.chain.light_client_server import LightClientServer
-    from lodestar_tpu.config import default_chain_config as cfg
+    cfg = resolve_chain_config(args)
     from lodestar_tpu.db import BeaconDb
     from lodestar_tpu.metrics import Metrics
     from lodestar_tpu.metrics.server import HttpMetricsServer
@@ -199,6 +236,24 @@ def run_beacon(args) -> int:
 
         anchor = _STATE_MF.deserialize(open(args.checkpoint_state, "rb").read())
         print(f"checkpoint sync: anchor slot {anchor.slot}", flush=True)
+    elif getattr(args, "checkpoint_sync_url", None):
+        # fetch the trusted node's finalized state over REST
+        # (networks/index.ts fetchWeakSubjectivityState)
+        from lodestar_tpu.api.client import ApiClient
+
+        async def _fetch():
+            client = ApiClient(args.checkpoint_sync_url)
+            try:
+                return await client.get_state_ssz("finalized")
+            finally:
+                await client.close()
+
+        anchor = asyncio.run(_fetch())
+        print(
+            f"checkpoint sync from {args.checkpoint_sync_url}: "
+            f"anchor slot {anchor.slot}",
+            flush=True,
+        )
     else:
         genesis_time = (
             args.genesis_time if args.genesis_time is not None else int(time.time())
@@ -233,6 +288,10 @@ def run_beacon(args) -> int:
             f"genesis_time={chain.genesis_time}",
             flush=True,
         )
+        # periodic status logline on stderr (node/notifier.ts:29)
+        from lodestar_tpu.node import run_node_notifier
+
+        notifier_task = asyncio.ensure_future(run_node_notifier(chain))
         last_slot = -1
         try:
             while True:
@@ -257,6 +316,7 @@ def run_beacon(args) -> int:
                         break
                 await asyncio.sleep(0.2)
         finally:
+            notifier_task.cancel()
             await msrv.close()
             await runner.cleanup()
             await chain.close()
@@ -270,7 +330,9 @@ def run_validator(args) -> int:
     import asyncio
 
     from lodestar_tpu.api.client import ApiClient
-    from lodestar_tpu.config import ForkConfig, default_chain_config as cfg
+    from lodestar_tpu.config import ForkConfig
+
+    cfg = resolve_chain_config(args)
     from lodestar_tpu.state_transition.util.interop import interop_secret_keys
     from lodestar_tpu.validator.validator import Validator
     from lodestar_tpu.validator.validator_store import ValidatorStore
@@ -319,7 +381,7 @@ def run_lightclient(args) -> int:
     import asyncio
 
     from lodestar_tpu.api.client import ApiClient
-    from lodestar_tpu.config import default_chain_config as cfg
+    cfg = resolve_chain_config(args)
     from lodestar_tpu.light_client import LightClient
     from lodestar_tpu.ssz.json import from_json
     from lodestar_tpu.types import ssz
@@ -382,7 +444,9 @@ def run_validator_exit(args) -> int:
     import asyncio
 
     from lodestar_tpu.api.client import ApiClient
-    from lodestar_tpu.config import ForkConfig, default_chain_config as cfg
+    from lodestar_tpu.config import ForkConfig
+
+    cfg = resolve_chain_config(args)
     from lodestar_tpu.state_transition.util.interop import interop_secret_keys
     from lodestar_tpu.validator.validator_store import ValidatorStore
 
@@ -439,7 +503,7 @@ def run_flare(args) -> int:
     import asyncio
 
     from lodestar_tpu.api.client import ApiClient
-    from lodestar_tpu.config import default_chain_config as cfg
+    cfg = resolve_chain_config(args)
     from lodestar_tpu.flare import (
         make_self_attester_slashing,
         make_self_proposer_slashing,

@@ -1,0 +1,163 @@
+"""Merge-transition watcher (reference:
+beacon-node/src/eth1/eth1MergeBlockTracker.ts): polls the eth1/execution
+provider for the terminal proof-of-work block — the first block whose
+total difficulty reaches TERMINAL_TOTAL_DIFFICULTY while its parent's is
+still below — and validates candidate merge blocks during block import
+(spec `validate_merge_block`, consumed by the bellatrix block path).
+
+State machine mirrors the reference's StatusCode:
+  PRE_MERGE -> SEARCHING_FOR_MERGE_BLOCK -> FOUND -> POST_MERGE
+POST_MERGE is entered externally once a finalized execution payload
+exists (the tracker is then shut down, eth1MergeBlockTracker.ts
+`mergeCompleted`).
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from enum import Enum
+from typing import Dict, Optional, Protocol
+
+
+@dataclass(frozen=True)
+class PowBlock:
+    """eth_getBlockByHash projection (merge fields only)."""
+
+    block_hash: bytes
+    parent_hash: bytes
+    total_difficulty: int
+
+
+class PowProvider(Protocol):
+    async def get_pow_block(self, block_hash: bytes) -> Optional[PowBlock]: ...
+    async def get_pow_head(self) -> Optional[PowBlock]: ...
+
+
+class MergeStatus(Enum):
+    PRE_MERGE = "PRE_MERGE"
+    SEARCHING = "SEARCHING_FOR_MERGE_BLOCK"
+    FOUND = "FOUND_MERGE_BLOCK"
+    POST_MERGE = "POST_MERGE"
+
+
+class MockPowChain:
+    """Scripted PoW chain for tests/sim (difficulty accumulates per
+    block); stands in for the EL's eth_getBlockByHash."""
+
+    def __init__(self, difficulty_per_block: int = 10):
+        self.blocks: Dict[bytes, PowBlock] = {}
+        self._head: Optional[PowBlock] = None
+        self.difficulty_per_block = difficulty_per_block
+
+    def mine(self, n: int = 1) -> PowBlock:
+        for _ in range(n):
+            parent = self._head
+            td = (parent.total_difficulty if parent else 0) + self.difficulty_per_block
+            num = len(self.blocks)
+            blk = PowBlock(
+                block_hash=num.to_bytes(8, "big").rjust(32, b"\x0f"),
+                parent_hash=parent.block_hash if parent else b"\x00" * 32,
+                total_difficulty=td,
+            )
+            self.blocks[blk.block_hash] = blk
+            self._head = blk
+        return self._head
+
+    async def get_pow_block(self, block_hash: bytes) -> Optional[PowBlock]:
+        return self.blocks.get(block_hash)
+
+    async def get_pow_head(self) -> Optional[PowBlock]:
+        return self._head
+
+
+class Eth1MergeBlockTracker:
+    def __init__(self, cfg, provider: PowProvider):
+        self.cfg = cfg
+        self.provider = provider
+        self.status = MergeStatus.PRE_MERGE
+        self.merge_block: Optional[PowBlock] = None
+        self._task: Optional[asyncio.Task] = None
+
+    # -- polling ---------------------------------------------------------
+
+    async def poll_once(self) -> Optional[PowBlock]:
+        """One head poll: advance the state machine, return the terminal
+        block if (now) known."""
+        if self.status in (MergeStatus.FOUND, MergeStatus.POST_MERGE):
+            return self.merge_block
+        head = await self.provider.get_pow_head()
+        if head is None:
+            return None
+        ttd = self.cfg.TERMINAL_TOTAL_DIFFICULTY
+        if head.total_difficulty < ttd:
+            self.status = MergeStatus.PRE_MERGE
+            return None
+        # TTD reached somewhere at or below head: walk parents until the
+        # crossing block (bounded by the distance TD can have overshot).
+        self.status = MergeStatus.SEARCHING
+        block = head
+        while True:
+            parent = await self.provider.get_pow_block(block.parent_hash)
+            if parent is None or parent.total_difficulty < ttd:
+                if parent is None and block.parent_hash != b"\x00" * 32:
+                    return None  # ancestor unavailable: keep searching
+                self.merge_block = block
+                self.status = MergeStatus.FOUND
+                return block
+            block = parent
+
+    def get_terminal_pow_block(self) -> Optional[PowBlock]:
+        """FOUND-state accessor (produceBlock asks for this pre-merge)."""
+        return self.merge_block
+
+    def merge_completed(self) -> None:
+        """Finalized execution payload seen — stop tracking."""
+        self.status = MergeStatus.POST_MERGE
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    # -- spec validate_merge_block (consumed on block import) ------------
+
+    async def validate_merge_block(self, parent_hash: bytes) -> bool:
+        """Spec validate_merge_block: the payload's parent must be a valid
+        terminal block (TD >= TTD, parent TD < TTD), or match
+        TERMINAL_BLOCK_HASH when that override is configured."""
+        if self.cfg.TERMINAL_BLOCK_HASH != b"\x00" * 32:
+            return parent_hash == self.cfg.TERMINAL_BLOCK_HASH
+        pow_block = await self.provider.get_pow_block(parent_hash)
+        if pow_block is None:
+            return False
+        pow_parent = await self.provider.get_pow_block(pow_block.parent_hash)
+        ttd = self.cfg.TERMINAL_TOTAL_DIFFICULTY
+        if pow_block.total_difficulty < ttd:
+            return False
+        if pow_parent is None:
+            # genesis-parent terminal block: valid iff TTD met from zero
+            return pow_block.parent_hash == b"\x00" * 32
+        return pow_parent.total_difficulty < ttd
+
+    # -- background loop -------------------------------------------------
+
+    async def start(self, interval_s: float = 12.0) -> None:
+        async def _loop():
+            while self.status not in (
+                MergeStatus.FOUND,
+                MergeStatus.POST_MERGE,
+            ):
+                try:
+                    await self.poll_once()
+                except Exception:
+                    pass
+                await asyncio.sleep(interval_s)
+
+        self._task = asyncio.create_task(_loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None

@@ -1,0 +1,112 @@
+"""MEV builder API client (reference:
+packages/beacon-node/src/execution/builder/http.ts; builder-specs REST).
+
+The blinded-block flow: the validator registers fee recipients, the node
+asks the builder for a header bid (getHeader), the proposer signs a
+blinded block over the header, and submitBlindedBlock reveals the payload.
+MockBuilder is the in-process double for tests/dev (the reference tests
+against mock-builder/mergemock the same way).
+"""
+from __future__ import annotations
+
+import secrets
+from typing import Dict, Optional
+
+from lodestar_tpu.params import ForkName
+from lodestar_tpu.types import ssz
+
+
+class BuilderApiError(Exception):
+    pass
+
+
+class HttpBuilderApi:
+    """builder-specs REST client (http.ts role)."""
+
+    def __init__(self, base_url: str, timeout: float = 12.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    async def _req(self, method: str, path: str, body: Optional[bytes] = None):
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.request(
+                method,
+                self.base_url + path,
+                data=body,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=aiohttp.ClientTimeout(total=self.timeout),
+            ) as resp:
+                data = await resp.read()
+                if resp.status >= 400:
+                    raise BuilderApiError(f"{path}: HTTP {resp.status}")
+                return data
+
+    async def check_status(self) -> None:
+        await self._req("GET", "/eth/v1/builder/status")
+
+    async def register_validators(self, signed_registrations) -> None:
+        t = ssz.bellatrix.SignedValidatorRegistrationV1
+        body = b"".join(t.serialize(r) for r in signed_registrations)
+        await self._req("POST", "/eth/v1/builder/validators", body)
+
+    async def get_header(self, slot: int, parent_hash: bytes, pubkey: bytes):
+        data = await self._req(
+            "GET",
+            f"/eth/v1/builder/header/{slot}/0x{parent_hash.hex()}/0x{pubkey.hex()}",
+        )
+        return ssz.bellatrix.SignedBuilderBid.deserialize(data)
+
+    async def submit_blinded_block(self, signed_blinded_block):
+        t = type(signed_blinded_block)
+        data = await self._req(
+            "POST", "/eth/v1/builder/blinded_blocks", t.serialize(signed_blinded_block)
+        )
+        return ssz.bellatrix.ExecutionPayload.deserialize(data)
+
+
+class MockBuilder:
+    """In-process builder double: bids with a payload built by the mock EL
+    builder and reveals it on submission."""
+
+    def __init__(self, value: int = 1_000_000):
+        self.value = value
+        self.registrations: Dict[bytes, object] = {}
+        self._payloads: Dict[bytes, object] = {}
+
+    async def check_status(self) -> None:
+        return None
+
+    async def register_validators(self, signed_registrations) -> None:
+        for r in signed_registrations:
+            self.registrations[bytes(r.message.pubkey)] = r.message
+
+    async def get_header(self, slot: int, parent_hash: bytes, pubkey: bytes):
+        from .engine import build_payload
+
+        reg = self.registrations.get(bytes(pubkey))
+        fee_recipient = bytes(reg.fee_recipient) if reg else b"\x00" * 20
+        payload = build_payload(
+            ForkName.bellatrix,
+            parent_hash=parent_hash,
+            timestamp=slot,
+            prev_randao=b"\x00" * 32,
+            fee_recipient=fee_recipient,
+            block_number=slot,
+        )
+        header = ssz.bellatrix.payload_to_header(payload)
+        self._payloads[bytes(payload.block_hash)] = payload
+        bid = ssz.bellatrix.BuilderBid(
+            header=header, value=self.value, pubkey=b"\xaa" * 48
+        )
+        return ssz.bellatrix.SignedBuilderBid(message=bid, signature=b"\x00" * 96)
+
+    async def submit_blinded_block(self, signed_blinded_block):
+        h = bytes(
+            signed_blinded_block.message.body.execution_payload_header.block_hash
+        )
+        payload = self._payloads.get(h)
+        if payload is None:
+            raise BuilderApiError("unknown blinded block payload")
+        return payload

@@ -19,8 +19,10 @@ from .peers import PeerAction, PeerManager
 from .reqresp import encoding as rr_enc
 from .reqresp.encoding import ReqRespError, RespStatus
 from .reqresp.protocols import (
+    BEACON_BLOCK_AND_BLOBS_SIDECAR_BY_ROOT,
     BEACON_BLOCKS_BY_RANGE,
     BEACON_BLOCKS_BY_ROOT,
+    BLOBS_SIDECARS_BY_RANGE,
     GOODBYE,
     METADATA,
     PING,
@@ -86,12 +88,45 @@ class Network:
                     out.append(blk)
             return out
 
+        async def on_blobs_sidecars_by_range(from_peer, req):
+            if req.count > 128:
+                raise ReqRespError(RespStatus.INVALID_REQUEST, "bad range")
+            out = []
+            for slot in range(req.start_slot, req.start_slot + req.count):
+                blk = self._block_at_slot(slot)
+                if blk is None:
+                    continue
+                root = type(blk.message).hash_tree_root(blk.message)
+                sc = self.db.blobs_sidecar.get(root)
+                if sc is not None:
+                    out.append(sc)
+            return out
+
+        async def on_block_and_blobs_by_root(from_peer, req):
+            out = []
+            for root in req:
+                blk = self.db.block.get(bytes(root))
+                sc = self.db.blobs_sidecar.get(bytes(root))
+                if blk is not None and sc is not None:
+                    out.append(
+                        ssz.eip4844.SignedBeaconBlockAndBlobsSidecar(
+                            beacon_block=blk, blobs_sidecar=sc
+                        )
+                    )
+            return out
+
         self.reqresp.register_handler(STATUS, on_status)
         self.reqresp.register_handler(PING, on_ping)
         self.reqresp.register_handler(METADATA, on_metadata)
         self.reqresp.register_handler(GOODBYE, on_goodbye)
         self.reqresp.register_handler(BEACON_BLOCKS_BY_RANGE, on_blocks_by_range)
         self.reqresp.register_handler(BEACON_BLOCKS_BY_ROOT, on_blocks_by_root)
+        self.reqresp.register_handler(
+            BLOBS_SIDECARS_BY_RANGE, on_blobs_sidecars_by_range
+        )
+        self.reqresp.register_handler(
+            BEACON_BLOCK_AND_BLOBS_SIDECAR_BY_ROOT, on_block_and_blobs_by_root
+        )
 
     def _block_at_slot(self, slot: int):
         # canonical root via fork choice ancestors of head
@@ -303,6 +338,43 @@ class Network:
             ssz.phase0.SignedAggregateAndProof,
             signed_agg,
         )
+
+    # ------------------------------------------------------------------
+    # discovery-driven peer top-up (peers/discover.ts + peerManager
+    # heartbeat: when below the target peer count, query discovery and
+    # dial what it found)
+    # ------------------------------------------------------------------
+
+    def attach_discovery(self, discovery, resolve_peer) -> None:
+        """`discovery` is a DiscoveryService; `resolve_peer(enr) ->
+        Optional[peer_id]` maps a discovered record onto a dialable
+        transport address (in-process: the sim's registry; production:
+        the ENR's ip/tcp_port)."""
+        self._discovery = discovery
+        self._resolve_peer = resolve_peer
+
+    async def heartbeat(self, target_peers: int = 8) -> int:
+        """One peer-maintenance round (peerManager.ts heartbeat):
+        disconnect bad-score peers, then top up from discovery.  Returns
+        the connected-peer count."""
+        for pid in list(self.peer_manager.connected_peers()):
+            if self.peer_manager.scores.should_disconnect(pid):
+                self.peer_manager.on_disconnect(pid)
+        discovery = getattr(self, "_discovery", None)
+        if discovery is not None:
+            connected = self.peer_manager.connected_peers()
+            if len(connected) < target_peers:
+                for enr in await discovery.discover_peers(
+                    target_peers - len(connected)
+                ):
+                    pid = self._resolve_peer(enr)
+                    if pid is None or pid in self.peer_manager.peers:
+                        continue
+                    try:
+                        await self.connect(pid)
+                    except Exception:
+                        continue
+        return len(self.peer_manager.connected_peers())
 
     def close(self) -> None:
         self.endpoint.close()

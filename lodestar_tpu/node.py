@@ -1,0 +1,88 @@
+"""Node-level composition helpers (reference: beacon-node/src/node/):
+the periodic status notifier (notifier.ts:29 runNodeNotifier) — the
+once-per-slot human-readable log line summarizing sync state, head,
+finalized checkpoint, peer count, and the execution/merge status.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from lodestar_tpu.utils import Logger
+
+
+def format_status_line(chain, network=None, sync=None) -> str:
+    """One notifier line (notifier.ts builds exactly this shape):
+
+      `synced - slot: 123 - head: 0xabcd… (slot 123) - finalized: 3 - peers: 8`
+    """
+    slot = chain.clock.current_slot
+    head_root = chain.head_root
+    head_slot = None
+    try:
+        head_slot = chain.fork_choice.get_block(
+            "0x" + head_root.hex()
+        ).slot  # proto-array node
+    except Exception:
+        pass
+    st = chain.fork_choice.store
+
+    if sync is not None and getattr(sync, "is_syncing", lambda: False)():
+        distance = max(0, slot - (head_slot if head_slot is not None else 0))
+        state = f"syncing ({distance} slots behind)"
+    elif head_slot is not None and slot - head_slot > 3:
+        state = f"stalled ({slot - head_slot} slots behind)"
+    else:
+        state = "synced"
+
+    parts = [
+        state,
+        f"slot: {slot}",
+        f"head: 0x{head_root.hex()[:8]}…"
+        + (f" (slot {head_slot})" if head_slot is not None else ""),
+        f"justified: {st.justified.epoch}",
+        f"finalized: {st.finalized.epoch}",
+    ]
+    if network is not None:
+        try:
+            parts.append(f"peers: {len(network.peer_manager.connected_peers())}")
+        except Exception:
+            pass
+    return " - ".join(parts)
+
+
+async def run_node_notifier(
+    chain,
+    network=None,
+    sync=None,
+    logger: Optional[Logger] = None,
+    *,
+    interval_s: Optional[float] = None,
+    stop_after: Optional[int] = None,
+) -> None:
+    """Log a status line once per slot (aligned to slot boundaries like
+    the reference's timeToNextSlot wait). Runs until cancelled, or for
+    `stop_after` lines (tests)."""
+    log = (logger or Logger("node")).child("notifier")
+    seconds_per_slot = float(
+        interval_s
+        if interval_s is not None
+        else getattr(chain.cfg, "SECONDS_PER_SLOT", 12)
+    )
+    emitted = 0
+    try:
+        while True:
+            log.info(format_status_line(chain, network, sync))
+            emitted += 1
+            if stop_after is not None and emitted >= stop_after:
+                return
+            # sleep to just past the next slot boundary, per the chain's
+            # own clock (works with injected/fake time sources)
+            try:
+                into = chain.clock.seconds_into_slot()
+                delay = max(0.05, min(seconds_per_slot - into + 0.01, seconds_per_slot))
+            except Exception:
+                delay = seconds_per_slot
+            await asyncio.sleep(delay)
+    except asyncio.CancelledError:
+        pass

@@ -34,7 +34,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .limbs import LIMB_BITS, MASK, NLIMBS, N0INV, ONE_MONT, P_LIMBS, R2_LIMBS
+from .limbs import (
+    LIMB_BITS,
+    MASK,
+    NLIMBS,
+    N0INV,
+    NPRIME_LIMBS,
+    ONE_MONT,
+    P_LIMBS,
+    R2_LIMBS,
+)
 
 _u32 = jnp.uint32
 
@@ -50,13 +59,9 @@ _P = jnp.asarray(P_LIMBS, dtype=_u32)
 _R2 = jnp.asarray(R2_LIMBS, dtype=_u32)
 _ONE_M = jnp.asarray(ONE_MONT, dtype=_u32)
 
-# Static limb-axis matrices (see module docstring): shifts and prefix-sums
-# as dots, pairwise masks for broadcast-compare carry resolution.
+# Static limb-axis shift matrices (see module docstring): shifts as dots.
 _SHIFT_UP_M = jnp.asarray(np.eye(NLIMBS, k=1, dtype=np.uint32))    # x @ M -> limb k = x[k-1]
 _SHIFT_DOWN_M = jnp.asarray(np.eye(NLIMBS, k=-1, dtype=np.uint32))  # x @ M -> limb k = x[k+1]
-_CUMSUM_INCL_M = jnp.asarray(np.triu(np.ones((NLIMBS, NLIMBS), dtype=np.uint32)))  # x @ M -> prefix sums
-# pairwise_lt[j, i] = 1 iff j < i  (j = source limb, i = destination limb)
-_PAIR_LT = jnp.asarray(np.tril(np.ones((NLIMBS, NLIMBS), dtype=np.uint32), k=-1).T)
 _E0 = jnp.asarray(np.eye(NLIMBS, dtype=np.uint32)[0])
 
 
@@ -93,35 +98,47 @@ def _carry_pass(x):
     return (x & MASK) + _shift_up(x >> LIMB_BITS)
 
 
+def _shift_lo(x, d: int):
+    """shifted[i] = x[i-d], zero-filled below (toward less significant)."""
+    pad = [(0, 0)] * (x.ndim - 1) + [(d, 0)]
+    return jnp.pad(x[..., : x.shape[-1] - d], pad)
+
+
 def _propagate(g, pr):
-    """Branch-free single-bit carry/borrow propagation.
+    """Branch-free single-bit carry/borrow propagation (any limb width).
 
     g[j]:  limb j generates (uint32 0/1).   pr[j]: limb j propagates.
     g and pr must be disjoint (a generating limb cannot also propagate).
     Returns (carry_in per limb, total carry-out), where
-      carry_in[i] = OR_{j<i} ( g[j] AND pr[j+1..i-1] all set )
-    computed via prefix-counts of non-propagating limbs: the span j+1..i-1
-    is all-propagate iff Z[i-1] == Z[j] with Z = inclusive cumsum of ~pr.
+      carry_in[i] = OR_{j<i} ( g[j] AND pr[j+1..i-1] all set ).
+
+    Kogge-Stone parallel prefix over the carry operator
+      (G, P) combine-with-lower (G', P')  =  (G | (P & G'), P & P')
+    log2(n) doubling steps of pure elementwise ops on (..., n) tensors —
+    linear work per step, no quadratic (n, n) intermediates (an earlier
+    prefix-count formulation built (..., n, n) masks; at a stacked-f12
+    batch width that materialized ~100 MB per carry resolve and dominated
+    kernel runtime).
     """
-    np_ = pr ^ _u32(1)
-    Z = _dot(np_, _CUMSUM_INCL_M)            # Z[k] = #non-propagating in 0..k
-    Zi1 = _shift_up(Z)                       # Z[i-1], 0 for i = 0
-    # A[..., j, i] = g[j] & (Z[i-1] == Z[j]) & (j < i)
-    eq = (Zi1[..., None, :] == Z[..., :, None]).astype(_u32)
-    A = g[..., :, None] * eq * _PAIR_LT
-    carry_in = A.max(axis=-2)
-    # carry out of the top limb: g[j] with pr[j+1..N-1] all set
-    total = (g * (Z[..., -1:] == Z).astype(_u32)).max(axis=-1)
+    n = g.shape[-1]
+    G, P = g, pr
+    d = 1
+    while d < n:
+        G = G | (P & _shift_lo(G, d))
+        P = P & _shift_lo(P, d)
+        d <<= 1
+    carry_in = _shift_lo(G, 1)               # carry INTO limb i = G[i-1]
+    total = G[..., -1]
     return carry_in, total
 
 
 def _resolve_single_carries(t):
-    """Exact canonicalization for limbs with single-bit carries.
+    """Exact canonicalization for limbs with single-bit carries (any width).
 
     Precondition: every limb of t is <= 2^14 - 2, so carry-out per limb is
     0 or 1 even with an incoming carry.  Callers stay within bound: add()
     feeds limbs <= 2*MASK = 2^14 - 2; sub() feeds d + P <= 2*MASK;
-    _norm_wide feeds limbs <= MASK + 61 after its two carry passes.
+    _norm_wide / mont_mul feed limbs <= MASK + ~64 after two carry passes.
     """
     g = (t >> LIMB_BITS).astype(_u32)          # t >= 2^13 -> generates
     pr = (t == MASK).astype(_u32)              # t == mask -> propagates
@@ -159,12 +176,36 @@ def _cond_sub_p(t):
 # ---------------------------------------------------------------------------
 
 
+def _flat_leading(fn):
+    """Collapse all leading axes to ONE before running `fn`, restore after.
+
+    The tower stacks products into leading axes ((3,6,3,B,NLIMBS) for an
+    f12 mul), and the carry machinery adds two more (..., N, N) — XLA's
+    layout/fusion passes are superlinear in tensor rank, and rank-7
+    intermediates were measured to dominate compile time.  Every fp entry
+    point therefore runs rank<=3 internally."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(a, b):
+        a, b = jnp.broadcast_arrays(a, b)
+        lead = a.shape[:-1]
+        if len(lead) > 1:
+            a2 = a.reshape((-1, a.shape[-1]))
+            b2 = b.reshape((-1, b.shape[-1]))
+            return fn(a2, b2).reshape((*lead, -1))
+        return fn(a, b)
+
+    return wrapped
+
+
+@_flat_leading
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _cond_sub_p(_resolve_single_carries(a + b))
 
 
+@_flat_leading
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    a, b = jnp.broadcast_arrays(a, b)
     d, borrow = _borrow_sub(a, b)
     # Where a < b the limbs represent a-b+2^390; adding p and dropping the
     # top carry (exactly 2^390) yields a-b+p in [0, p).
@@ -190,8 +231,10 @@ def _cios_step(u, a_i, b):
     return _dot(u, _SHIFT_DOWN_M) + carry[..., None] * _E0
 
 
-def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Montgomery product a*b*R^{-1} mod p, canonical output (CIOS)."""
+def mont_mul_cios(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product via the serial CIOS scan (kept as the reference
+    implementation / fallback; the default mont_mul is the parallel
+    full-product reduction below)."""
     a, b = jnp.broadcast_arrays(a, b)
     if CIOS_UNROLL:
         u = jnp.zeros_like(b)
@@ -202,6 +245,96 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         u, _ = jax.lax.scan(lambda u, ai: (_cios_step(u, ai, b), None),
                             jnp.zeros_like(b), a_s)
     return _cond_sub_p(_norm_wide(u))
+
+
+# ---------------------------------------------------------------------------
+# parallel Montgomery multiplication (no serial limb scan)
+#
+# The CIOS scan above serializes 30 dependent steps per product; a pairing
+# is ~10^5 products, so the whole program becomes a chain of ~10^7 tiny VPU
+# ops and runs latency-bound (~1 us/op on v5e) regardless of batch size.
+# Here the product and the Montgomery reduction are each ONE wide data-
+# parallel expression over (..., 2N)-limb tensors:
+#
+#     U  = a * b                      (schoolbook convolution, 59 limbs)
+#     mu = (U mod R) * N' mod R       (low-half convolution, R = 2^390)
+#     T  = U + mu * p                 (T = 0 mod R by construction)
+#     out = T / R  in [0, 2p) -> cond_sub
+#
+# Convolutions are gather+multiply+reduce (no data-dependent control flow);
+# carries resolve with the branch-free passes/_propagate machinery.  Every
+# intermediate fits uint32: products are <= 8223 * 8191 * 30 < 2^31 and the
+# sum U + mu*p <= 2^31 + 8223 (see the per-step bounds in comments).
+# ---------------------------------------------------------------------------
+
+_NPRIME = jnp.asarray(NPRIME_LIMBS, dtype=_u32)
+_WIDE = 2 * NLIMBS - 1  # 59 limbs in a raw product
+
+
+def _conv_idx(out_width: int) -> np.ndarray:
+    """IDX[i, k] = k - i where valid else NLIMBS (a zero slot): gathers
+    shifted copies of a zero-padded multiplicand so that
+    sum_i a[i] * b_pad[IDX[i, k]] = (a conv b)[k]."""
+    idx = np.full((NLIMBS, out_width), NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        for k in range(out_width):
+            j = k - i
+            if 0 <= j < NLIMBS:
+                idx[i, k] = j
+    return idx
+
+
+_IDX_FULL = jnp.asarray(_conv_idx(_WIDE))     # (30, 59)
+_IDX_LOW = jnp.asarray(_conv_idx(NLIMBS))     # (30, 30): product mod R
+
+def _conv(a, b, idx):
+    """Limb convolution sum_{i+j=k} a_i*b_j via one gather + one reduce.
+
+    Output limb k <= 30 * max(a) * max(b); callers keep that < 2^32.
+    """
+    b_pad = jnp.concatenate([b, jnp.zeros_like(b[..., :1])], axis=-1)
+    shifts = b_pad[..., idx]                  # (..., 30, out_width)
+    return (a[..., :, None] * shifts).sum(axis=-2)
+
+
+def _carry_widen(x, grow: int = 1):
+    """One carry pass that widens by `grow` limbs (no truncation)."""
+    lo = x & MASK
+    hi = x >> LIMB_BITS
+    pad_tail = [(0, 0)] * (x.ndim - 1) + [(0, grow)]
+    pad_head = [(0, 0)] * (x.ndim - 1) + [(1, grow - 1)]
+    return jnp.pad(lo, pad_tail) + jnp.pad(hi, pad_head)
+
+
+def _carry_trunc(x):
+    """One carry pass at fixed width (drops the top carry: mod 2^(13*n))."""
+    lo = x & MASK
+    hi = x >> LIMB_BITS
+    return lo + jnp.pad(hi[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+
+
+@_flat_leading
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product a*b*R^{-1} mod p, canonical output (parallel)."""
+    # U = a*b: 59 limbs <= 30*8191^2 < 2^31
+    u = _conv(a, b, _IDX_FULL)
+    # two widening passes: limbs <= 8191 + 31 (=: B1), width 61
+    u = _carry_widen(_carry_widen(u))
+    # mu = (U mod R) * N' mod R: low conv of U's low half; since dropping
+    # limbs >= 30 only removes multiples of R, the result is exact mod R.
+    # products <= B1 * 8191 * 30 < 2^31
+    mu = _conv(u[..., :NLIMBS], jnp.broadcast_to(_NPRIME, a.shape), _IDX_LOW)
+    mu = _carry_trunc(_carry_trunc(mu))       # limbs <= B1, exact mod R
+    # T = U + mu*p: mu*p limbs <= B1 * 8191 * 30 < 2^31 - B1
+    mp = _conv(mu, jnp.broadcast_to(_P, a.shape), _IDX_FULL)
+    pad = [(0, 0)] * (u.ndim - 1) + [(0, 2)]
+    t = u + jnp.pad(mp, pad)                  # width 61
+    # exact canonicalization: T = 0 mod R, so limbs 0..29 cancel to zero
+    # and T/R is literally limbs 30..59 of the canonical form
+    t = _carry_widen(_carry_widen(t))         # width 63, limbs <= 2^14 - 2
+    t = _resolve_single_carries(t)
+    res = t[..., NLIMBS : 2 * NLIMBS]         # T/R < 2p (Montgomery bound)
+    return _cond_sub_p(res)
 
 
 def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -246,20 +379,46 @@ def _exp_bits(e: int) -> np.ndarray:
 def mont_pow_fixed(a: jnp.ndarray, e: int) -> jnp.ndarray:
     """a^e in Montgomery form (a Montgomery in, result Montgomery out).
 
-    One lax.scan over the exponent bits; always-multiply-then-select keeps
-    the body branch-free.
+    Fixed-window (4-bit) exponentiation: precompute a^0..a^15 once, then
+    scan the exponent's nibbles MSB-first with 4 squarings + ONE
+    table-lookup multiply per window.  Halves the multiply count of the
+    bitwise square-and-multiply ladder (the Fermat inversions a^(p-2) are
+    ~15% of the whole verification program's op count).
     """
     if e == 0:
         return jnp.broadcast_to(_ONE_M, a.shape)
-    bits = jnp.asarray(_exp_bits(e))
+    if e < 16:
+        # tiny exponents: plain ladder
+        acc = jnp.broadcast_to(_ONE_M, a.shape)
+        for bit in _exp_bits(e):
+            acc = mont_mul(acc, acc)
+            if bit:
+                acc = mont_mul(acc, a)
+        return acc
 
-    def body(acc, bit):
-        acc = mont_mul(acc, acc)
-        acc = select(bit != 0, mont_mul(acc, a), acc)
+    # nibble digits, MSB-first, padded to whole windows
+    ndigits = (e.bit_length() + 3) // 4
+    digits = np.array(
+        [(e >> (4 * (ndigits - 1 - i))) & 0xF for i in range(ndigits)],
+        dtype=np.int32,
+    )
+
+    # table a^0 .. a^15 stacked on a new leading axis (one-time 14 muls)
+    pows = [jnp.broadcast_to(_ONE_M, a.shape), a]
+    sq = mont_mul(a, a)
+    pows.append(sq)
+    for _ in range(13):
+        pows.append(mont_mul(pows[-1], a))
+    table = jnp.stack(pows)  # (16, ..., NLIMBS)
+
+    def body(acc, d):
+        for _ in range(4):
+            acc = mont_mul(acc, acc)
+        acc = mont_mul(acc, table[d])
         return acc, None
 
     acc = jnp.broadcast_to(_ONE_M, a.shape)
-    acc, _ = jax.lax.scan(body, acc, bits)
+    acc, _ = jax.lax.scan(body, acc, jnp.asarray(digits))
     return acc
 
 

@@ -24,8 +24,11 @@ R_EXP = LIMB_BITS * NLIMBS  # 390
 R = 1 << R_EXP
 assert R > P * 2, "R must exceed 2p for Montgomery bounds"
 
-# -p^{-1} mod 2^LIMB_BITS — the Montgomery n' constant.
+# -p^{-1} mod 2^LIMB_BITS — the per-limb Montgomery n' constant (CIOS).
 N0INV = (-pow(P, -1, 1 << LIMB_BITS)) & MASK
+# -p^{-1} mod R — the full-width Montgomery constant for the parallel
+# (product-scanning-free) reduction in fp.mont_mul.
+NPRIME = (-pow(P, -1, R)) % R
 # R^2 mod p — multiply by this (Montgomery) to convert into Montgomery form.
 R2 = (R * R) % P
 # R mod p — the Montgomery representation of 1.
@@ -55,6 +58,7 @@ def limbs_to_int(limbs) -> int:
 P_LIMBS = int_to_limbs(P)
 R2_LIMBS = int_to_limbs(R2)
 ONE_MONT = int_to_limbs(R1)  # 1 in Montgomery form
+NPRIME_LIMBS = int_to_limbs(NPRIME)
 ZERO = np.zeros(NLIMBS, dtype=np.uint32)
 
 

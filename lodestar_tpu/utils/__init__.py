@@ -1,0 +1,153 @@
+"""Shared runtime utilities — the rebuild's `@lodestar/utils`
+(reference: packages/utils/src: logger/winston.ts, sleep.ts, retry.ts,
+bytes.ts hex helpers).
+
+The logger mirrors the reference's winston setup in shape: leveled,
+per-module child loggers, one line per record with an ISO timestamp and
+the module chain, writing to stderr (and optionally a file) so stdout
+stays clean for machine-readable output (the CLI's JSON lines).
+"""
+from __future__ import annotations
+
+import asyncio
+import sys
+import time
+from enum import IntEnum
+from typing import Awaitable, Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+# ---------------------------------------------------------------------------
+# logger (utils/src/logger/winston.ts role)
+# ---------------------------------------------------------------------------
+
+
+class LogLevel(IntEnum):
+    error = 0
+    warn = 1
+    info = 2
+    verbose = 3
+    debug = 4
+    trace = 5
+
+
+class Logger:
+    """Leveled logger with child-module chaining (`logger.child("chain")`
+    prints records as `[node chain] ...` like the reference's winston
+    childLogger-per-subsystem pattern, node/nodejs.ts:166)."""
+
+    def __init__(
+        self,
+        module: str = "",
+        level: LogLevel = LogLevel.info,
+        stream=None,
+        file_path: Optional[str] = None,
+        _shared=None,
+    ):
+        self.module = module
+        self.level = level
+        self._stream = stream if stream is not None else sys.stderr
+        # file handle shared between a logger and its children
+        self._shared = _shared if _shared is not None else {"file": None}
+        if file_path:
+            self._shared["file"] = open(file_path, "a")
+
+    def child(self, module: str) -> "Logger":
+        name = f"{self.module} {module}".strip()
+        return Logger(name, self.level, self._stream, _shared=self._shared)
+
+    def _log(self, level: LogLevel, msg: str, **ctx) -> None:
+        if level > self.level:
+            return
+        ts = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
+        ctx_s = " ".join(f"{k}={v}" for k, v in ctx.items())
+        mod = f"[{self.module}] " if self.module else ""
+        line = f"{ts} {level.name:<7} {mod}{msg}" + (f" {ctx_s}" if ctx_s else "")
+        print(line, file=self._stream, flush=True)
+        f = self._shared.get("file")
+        if f is not None:
+            print(line, file=f, flush=True)
+
+    def error(self, msg: str, **ctx) -> None:
+        self._log(LogLevel.error, msg, **ctx)
+
+    def warn(self, msg: str, **ctx) -> None:
+        self._log(LogLevel.warn, msg, **ctx)
+
+    def info(self, msg: str, **ctx) -> None:
+        self._log(LogLevel.info, msg, **ctx)
+
+    def verbose(self, msg: str, **ctx) -> None:
+        self._log(LogLevel.verbose, msg, **ctx)
+
+    def debug(self, msg: str, **ctx) -> None:
+        self._log(LogLevel.debug, msg, **ctx)
+
+
+_root = Logger()
+
+
+def get_logger(module: str = "", level: Optional[LogLevel] = None) -> Logger:
+    lg = _root.child(module) if module else _root
+    if level is not None:
+        lg.level = level
+    return lg
+
+
+# ---------------------------------------------------------------------------
+# sleep / retry (utils/src/{sleep,retry}.ts)
+# ---------------------------------------------------------------------------
+
+
+async def sleep(seconds: float) -> None:
+    await asyncio.sleep(seconds)
+
+
+class RetryError(Exception):
+    def __init__(self, attempts: int, last: BaseException):
+        super().__init__(f"all {attempts} attempts failed: {last!r}")
+        self.attempts = attempts
+        self.last = last
+
+
+async def retry(
+    fn: Callable[[], Awaitable[T]],
+    retries: int = 3,
+    retry_delay: float = 0.5,
+    should_retry: Optional[Callable[[BaseException], bool]] = None,
+) -> T:
+    """Run `fn` up to `retries` times with a fixed delay between attempts
+    (reference retry.ts semantics: shouldRetry gates each re-attempt)."""
+    last: Optional[BaseException] = None
+    for attempt in range(retries):
+        try:
+            return await fn()
+        except Exception as e:  # noqa: BLE001 — retry boundary
+            last = e
+            if should_retry is not None and not should_retry(e):
+                raise
+            if attempt < retries - 1:
+                await asyncio.sleep(retry_delay)
+    raise RetryError(retries, last)
+
+
+# ---------------------------------------------------------------------------
+# bytes/hex helpers (utils/src/bytes.ts)
+# ---------------------------------------------------------------------------
+
+
+def to_hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def from_hex(s: str) -> bytes:
+    return bytes.fromhex(s.removeprefix("0x"))
+
+
+def bytes_to_int(b: bytes) -> int:
+    return int.from_bytes(b, "little")
+
+
+def int_to_bytes(x: int, length: int) -> bytes:
+    return int(x).to_bytes(length, "little")

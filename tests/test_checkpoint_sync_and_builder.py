@@ -1,0 +1,115 @@
+"""Weak-subjectivity checkpoint sync over REST (debug state SSZ route ->
+second node anchored on it) and the MEV builder blinded-block flow
+(reference: cmds/beacon/initBeaconState.ts:83-106, execution/builder/).
+"""
+import asyncio
+
+import pytest
+
+from lodestar_tpu.api.client import ApiClient
+from lodestar_tpu.api.server import BeaconRestApiServer
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.config import minimal_chain_config as cfg
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.params import ACTIVE_PRESET_NAME, ForkName
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.types import fork_of_state, ssz
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+
+def test_checkpoint_sync_over_rest():
+    async def go():
+        _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+        chain = BeaconChain(
+            cfg, BeaconDb(), anchor,
+            clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=lambda: 0.0),
+        )
+        server = BeaconRestApiServer(chain, chain.db)
+        port = await server.listen()
+        api = ApiClient(f"http://127.0.0.1:{port}")
+        try:
+            # the client side of fetchWeakSubjectivityState
+            state = await api.get_state_ssz("finalized")
+            assert type(state).hash_tree_root(state) == type(
+                anchor
+            ).hash_tree_root(anchor)
+            # a second node can anchor a chain on the downloaded state
+            chain2 = BeaconChain(
+                cfg, BeaconDb(), state,
+                clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=lambda: 0.0),
+            )
+            assert chain2.genesis_validators_root == chain.genesis_validators_root
+        finally:
+            await api.close()
+            await server.close()
+
+    asyncio.run(go())
+
+
+def test_builder_blinded_block_flow():
+    from lodestar_tpu.execution.builder import MockBuilder
+
+    async def go():
+        builder = MockBuilder(value=42)
+        reg = ssz.bellatrix.SignedValidatorRegistrationV1(
+            message=ssz.bellatrix.ValidatorRegistrationV1(
+                fee_recipient=b"\xfe" * 20,
+                gas_limit=30_000_000,
+                timestamp=0,
+                pubkey=b"\xaa" * 48,
+            ),
+            signature=b"\x00" * 96,
+        )
+        await builder.register_validators([reg])
+
+        parent = b"\x01" * 32
+        bid = await builder.get_header(5, parent, b"\xaa" * 48)
+        header = bid.message.header
+        assert bytes(header.parent_hash) == parent
+        assert bytes(header.fee_recipient) == b"\xfe" * 20
+        assert bid.message.value == 42
+
+        # blinded block commits to the header; submit reveals the payload
+        blinded = ssz.bellatrix.SignedBlindedBeaconBlock.default()
+        blinded.message.body.execution_payload_header = header
+        payload = await builder.submit_blinded_block(blinded)
+        assert ssz.bellatrix.payload_to_header(payload) == header
+
+    asyncio.run(go())
+
+
+def test_utils_logger_and_retry():
+    import io
+
+    from lodestar_tpu.utils import Logger, LogLevel, RetryError, retry
+
+    buf = io.StringIO()
+    log = Logger("node", LogLevel.info, stream=buf)
+    log.child("chain").info("imported", slot=3)
+    log.debug("hidden")
+    out = buf.getvalue()
+    assert "[node chain] imported slot=3" in out and "hidden" not in out
+
+    async def go():
+        calls = []
+
+        async def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("boom")
+            return "ok"
+
+        assert await retry(flaky, retries=5, retry_delay=0) == "ok"
+        assert len(calls) == 3
+
+        async def always():
+            raise RuntimeError("nope")
+
+        with pytest.raises(RetryError):
+            await retry(always, retries=2, retry_delay=0)
+
+    asyncio.run(go())
