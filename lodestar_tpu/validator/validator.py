@@ -44,6 +44,8 @@ class Validator:
         self.produced_blocks = 0
         self.produced_attestations = 0
         self.produced_aggregates = 0
+        self._announced_duty_epochs: set = set()
+        self._selection_proofs: Dict[tuple, bytes] = {}
 
     async def initialize(self) -> None:
         """Map pubkeys to validator indices (validator.ts
@@ -104,7 +106,7 @@ class Validator:
         for duty in duties:
             if duty.slot != slot:
                 continue
-            proof = self.store.sign_selection_proof(duty.pubkey, slot)
+            proof = self._selection_proof(duty.pubkey, slot)
             if not is_aggregator_from_committee_length(duty.committee_length, proof):
                 continue
             data = await self.api.produce_attestation_data(slot, duty.committee_index)
@@ -129,7 +131,7 @@ class Validator:
 
     async def _attester_duties(self, epoch: int) -> List[AttesterDuty]:
         raw = await self.api.get_attester_duties(epoch, self.indices)
-        return [
+        duties = [
             AttesterDuty(
                 pubkey=bytes.fromhex(d["pubkey"][2:]),
                 validator_index=int(d["validator_index"]),
@@ -141,6 +143,42 @@ class Validator:
             )
             for d in raw
         ]
+        # announce duty subnets to the node so its attnets service meshes
+        # them ahead of time (attestationDuties.ts prepareBeaconCommittee-
+        # Subnet call); aggregator flag from the local selection proof
+        if duties and epoch not in self._announced_duty_epochs:
+            subs = [
+                {
+                    "validator_index": d.validator_index,
+                    "committee_index": d.committee_index,
+                    "committees_at_slot": d.committees_at_slot,
+                    "slot": d.slot,
+                    "is_aggregator": is_aggregator_from_committee_length(
+                        d.committee_length,
+                        self._selection_proof(d.pubkey, d.slot),
+                    ),
+                }
+                for d in duties
+            ]
+            try:
+                await self.api.prepare_beacon_committee_subnet(subs)
+            except Exception:
+                pass  # transient / route-missing: retried next duty fetch
+            else:
+                self._announced_duty_epochs.add(epoch)
+        return duties
+
+    def _selection_proof(self, pubkey: bytes, slot: int) -> bytes:
+        """Memoized aggregator selection proof: the announce path and
+        aggregate_if_due need the same (pubkey, slot) signature."""
+        key = (pubkey, slot)
+        proof = self._selection_proofs.get(key)
+        if proof is None:
+            proof = self.store.sign_selection_proof(pubkey, slot)
+            if len(self._selection_proofs) > 4096:
+                self._selection_proofs.clear()
+            self._selection_proofs[key] = proof
+        return proof
 
     async def run_slot(self, slot: int) -> None:
         await self.propose_if_due(slot)

@@ -1,0 +1,143 @@
+"""Attnets/syncnets subnet services (reference:
+network/subnets/{attnetsService,syncnetsService}.ts).
+"""
+import pytest
+
+from lodestar_tpu.network.subnets import (
+    AttnetsService,
+    CommitteeSubscription,
+    EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION,
+    SyncnetsService,
+    _random_subnet,
+)
+from lodestar_tpu.params import ACTIVE_PRESET as _p
+
+
+class FakeClock:
+    def __init__(self):
+        self.current_slot = 0
+
+
+class FakeNetwork:
+    def __init__(self):
+        self.att_subs = set()
+        self.sync_subs = set()
+
+    def subscribe_attestation_subnet(self, subnet):
+        self.att_subs.add(subnet)
+
+    def unsubscribe_attestation_subnet(self, subnet):
+        self.att_subs.discard(subnet)
+
+    def subscribe_sync_committee_subnet(self, subnet):
+        self.sync_subs.add(subnet)
+
+    def unsubscribe_sync_committee_subnet(self, subnet):
+        self.sync_subs.discard(subnet)
+
+
+def _sub(vidx, slot, committee_index=0, aggregator=False):
+    return CommitteeSubscription(
+        validator_index=vidx,
+        committees_at_slot=2,
+        slot=slot,
+        committee_index=committee_index,
+        is_aggregator=aggregator,
+    )
+
+
+def test_duty_subscription_lifecycle():
+    net, clock = FakeNetwork(), FakeClock()
+    svc = AttnetsService(net, clock)
+    svc.add_committee_subscriptions([_sub(3, slot=10, aggregator=True)])
+    # duty subnet + the validator's long-lived random subnet
+    assert len(net.att_subs) >= 1
+    from lodestar_tpu.chain.validation import compute_subnet_for_attestation
+
+    duty_subnet = compute_subnet_for_attestation(2, 10, 0)
+    assert duty_subnet in net.att_subs
+    assert svc.should_process_attestation(10, duty_subnet)
+    assert not svc.should_process_attestation(11, duty_subnet)
+    # past the duty slot the short-lived sub expires; the long-lived
+    # random subnet stays
+    svc.on_slot(12)
+    long_lived = {_random_subnet(3, 0, 0)}
+    assert net.att_subs == long_lived
+    assert not svc.should_process_attestation(10, duty_subnet)
+
+
+def test_long_lived_rotation():
+    net, clock = FakeNetwork(), FakeClock()
+    svc = AttnetsService(net, clock)
+    svc.add_committee_subscriptions([_sub(7, slot=1)])
+    svc.on_slot(3)  # past the duty slot: only the long-lived sub remains
+    first = set(net.att_subs)
+    assert first == {_random_subnet(7, 0, 0)}
+    # jump one rotation period ahead: the long-lived subnet rotates
+    rotation_slot = EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION * _p.SLOTS_PER_EPOCH + 2
+    svc.on_slot(rotation_slot)
+    second = set(net.att_subs)
+    assert second == {_random_subnet(7, 1, 0)}
+
+
+def test_syncnets_positions():
+    from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_SIZE
+
+    net = FakeNetwork()
+    svc = SyncnetsService(net)
+    svc.subscribe_for_positions([0, SYNC_COMMITTEE_SUBNET_SIZE])  # subnets 0,1
+    assert net.sync_subs == {0, 1}
+    svc.unsubscribe_all()
+    assert net.sync_subs == set()
+
+
+def test_rest_route_feeds_attnets_service():
+    """POST beacon_committee_subscriptions -> AttnetsService (end of the
+    prepareBeaconCommitteeSubnet path)."""
+    import asyncio
+
+    from lodestar_tpu.params import ACTIVE_PRESET_NAME
+
+    if ACTIVE_PRESET_NAME != "minimal":
+        pytest.skip("minimal preset only")
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from lodestar_tpu.api.server import BeaconRestApiServer
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.chain.clock import LocalClock
+    from lodestar_tpu.config import minimal_chain_config as cfg
+    from lodestar_tpu.db import BeaconDb
+    from lodestar_tpu.network import InProcessHub, Network
+    from lodestar_tpu.state_transition.util.genesis import init_dev_state
+
+    async def go():
+        _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+        chain = BeaconChain(
+            cfg, BeaconDb(), anchor,
+            clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=lambda: 0.0),
+        )
+        net = Network(InProcessHub(), chain, chain.db)
+        api = BeaconRestApiServer(chain, chain.db, network=net)
+        client = TestClient(TestServer(api.app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/eth/v1/validator/beacon_committee_subscriptions",
+                json=[
+                    {
+                        "validator_index": 1,
+                        "committee_index": 0,
+                        "committees_at_slot": 1,
+                        "slot": 4,
+                        "is_aggregator": True,
+                    }
+                ],
+            )
+            assert resp.status == 200
+            assert len(net.attnets_service.active_subnets) >= 1
+        finally:
+            await client.close()
+            await chain.close()
+
+    asyncio.run(go())
