@@ -123,6 +123,10 @@ class BeaconRestApiServer:
             "/eth/v1/beacon/light_client/optimistic_update",
             self.get_lc_optimistic_update,
         )
+        # proofs (beacon/routes/proof.ts getStateProof role; deviation:
+        # single field-path proofs via query param instead of compact
+        # multiproof descriptors — the SSZ engine is value-backed)
+        r.add_get("/eth/v1/beacon/proof/state/{state_id}", self.get_state_proof)
         # events + debug
         r.add_get("/eth/v1/events", self.get_events)
         r.add_get("/eth/v1/debug/beacon/heads", self.get_debug_heads)
@@ -771,6 +775,46 @@ class BeaconRestApiServer:
         finally:
             self._event_queues.remove(entry)
         return resp
+
+    async def get_state_proof(self, request):
+        """Merkle proof of a state field path against the state root
+        (proof.ts getStateProof; path=dot-separated container fields)."""
+        path = request.query.get("path", "")
+        if not path:
+            return _err(400, "missing ?path=field[.field...]")
+        st = self._resolve_state(request.match_info["state_id"])
+        if st is None:
+            return _err(404, "state not found")
+        from lodestar_tpu.ssz.proof import container_field_proof
+
+        state = st.state
+        try:
+            leaf, branch, depth, index = container_field_proof(
+                type(state), state, path.split(".")
+            )
+        except (KeyError, ValueError, AttributeError) as e:
+            return _err(400, f"bad path: {e!r}")
+        gindex = (1 << depth) | index
+        # derive the apex from the proof itself (a second full-state
+        # merkleization here would double a multi-second hash pass on
+        # mainnet-scale states)
+        import hashlib as _hl
+
+        node, idx = leaf, index
+        for sib in branch:
+            pair = sib + node if idx & 1 else node + sib
+            node = _hl.sha256(pair).digest()
+            idx >>= 1
+        return _ok(
+            {
+                "leaf": "0x" + leaf.hex(),
+                "branch": ["0x" + b.hex() for b in branch],
+                "depth": depth,
+                "index": index,
+                "gindex": str(gindex),
+                "state_root": "0x" + node.hex(),
+            }
+        )
 
     async def get_debug_state_ssz(self, request):
         """Full state as fork-tagged SSZ bytes (debug/getStateV2 role) —

@@ -313,9 +313,30 @@ def _carry_trunc(x):
     return lo + jnp.pad(hi[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
 
 
+# Pallas-fused mont_mul on TPU backends (3.7x the XLA expression form —
+# see pallas_fp.py); LODESTAR_TPU_PALLAS=0 opts out.  Decided at trace
+# time: CPU (tests, virtual mesh) keeps the XLA path below.
+import os as _os
+
+PALLAS = _os.environ.get("LODESTAR_TPU_PALLAS", "1") != "0"
+
+
+def _use_pallas() -> bool:
+    if not PALLAS:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 @_flat_leading
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Montgomery product a*b*R^{-1} mod p, canonical output (parallel)."""
+    if _use_pallas():
+        from . import pallas_fp
+
+        return pallas_fp.mont_mul(a, b)
     # U = a*b: 59 limbs <= 30*8191^2 < 2^31
     u = _conv(a, b, _IDX_FULL)
     # two widening passes: limbs <= 8191 + 31 (=: B1), width 61

@@ -1,0 +1,298 @@
+"""Pallas-fused Montgomery multiplication for the Fp limb engine.
+
+Why: the XLA expression form of ``fp.mont_mul`` lowers to ~20 separate
+HBM-roundtripping ops per product (convolution gathers, carry passes,
+Kogge-Stone steps).  A pairing chains thousands of products, so the
+program is HBM-bandwidth bound.  This kernel computes the whole product +
+Montgomery reduction + canonicalization in ONE ``pallas_call`` with every
+intermediate in VMEM/registers.  Measured on TPU v5e at the stacked-f12
+working width (N=27,648 elements, 32-deep dependency chain): 281 us per
+product vs 1050 us for the XLA path — 3.7x.
+
+Layout: the kernel runs **limbs-on-sublanes / elements-on-lanes**
+((NLIMBS, N) blocks) so the convolution's limb shifts and the
+Kogge-Stone carry steps are sublane moves (nearly free) and all 128
+lanes carry real elements.  The public ``mont_mul`` keeps fp.py's
+``(..., NLIMBS)`` convention and transposes at the boundary — measured
+free: XLA fuses/cancels the transposes between chained products.
+
+Algorithm and overflow bounds are exactly fp.mont_mul's (see its
+docstring audit):
+
+    U  = a * b                 (schoolbook convolution, 59 limbs)
+    mu = (U mod R) * N' mod R  (low-half convolution, R = 2^390)
+    T  = (U + mu * p) / R      (exact; in [0, 2p) -> cond-subtract p)
+
+Dispatch: fp.mont_mul routes here on TPU backends unless
+LODESTAR_TPU_PALLAS=0.  CPU tests exercise the kernel through the Pallas
+interpreter (tests/test_pallas_fp.py); production CPU stays on the XLA
+path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .limbs import LIMB_BITS, MASK, NLIMBS, NPRIME_LIMBS, P_LIMBS
+
+_u32 = jnp.uint32
+_WIDE = 2 * NLIMBS - 1            # 59 limbs in a raw product
+_BLOCK = 512                      # element lanes per grid step
+
+_NPRIME_NP = np.asarray(NPRIME_LIMBS, dtype=np.uint32)
+_P_NP = np.asarray(P_LIMBS, dtype=np.uint32)
+
+
+# --- sublane-axis ports of fp.py's branch-free carry machinery --------------
+
+
+def _shl_rows(x, d: int):
+    """shifted[i] = x[i-d] along the limb (sublane) axis, zero-filled."""
+    if d == 0:
+        return x
+    return jnp.pad(x[: x.shape[0] - d], ((d, 0), (0, 0)))
+
+
+def _propagate(g, pr):
+    """Kogge-Stone carry/borrow prefix (fp._propagate, sublane version).
+    Returns (carry_in rows, carry_out row)."""
+    n = g.shape[0]
+    G, P = g, pr
+    d = 1
+    while d < n:
+        G = G | (P & _shl_rows(G, d))
+        P = P & _shl_rows(P, d)
+        d <<= 1
+    # static slice: negative indexing lowers to dynamic_slice, which the
+    # Mosaic TPU lowering does not implement
+    top = jax.lax.slice_in_dim(G, n - 1, n, axis=0)
+    return _shl_rows(G, 1), top
+
+
+def _resolve_single_carries(t):
+    """Exact canonicalization; precondition limbs <= 2^14 - 2."""
+    g = (t >> LIMB_BITS).astype(_u32)
+    pr = (t == MASK).astype(_u32)
+    carry_in, _ = _propagate(g, pr)
+    return (t + carry_in) & MASK
+
+
+def _carry_widen(x, width: int):
+    """One carry pass producing `width` limb rows (no truncation)."""
+    lo = x & MASK
+    hi = x >> LIMB_BITS
+    n = x.shape[0]
+    lo = jnp.pad(lo, ((0, width - n), (0, 0)))
+    hi = jnp.pad(hi[: width - 1], ((1, 0), (0, 0)))
+    return lo + hi
+
+
+def _carry_trunc(x):
+    lo = x & MASK
+    hi = x >> LIMB_BITS
+    return lo + jnp.pad(hi[:-1], ((1, 0), (0, 0)))
+
+
+# --- value-level field ops inside the kernel (limbs-first layout) -----------
+
+
+def _cond_sub_p(res, p_col):
+    """fp._cond_sub_p: canonicalize [0, 2p) -> [0, p)."""
+    g = (res < p_col).astype(_u32)
+    pr = (res == p_col).astype(_u32)
+    borrow_in, borrow_out = _propagate(g, pr)
+    dsub = (res + _u32(1 << LIMB_BITS) - p_col - borrow_in) & MASK
+    return jnp.where(borrow_out != 0, res, dsub)
+
+
+def _add_mod(a, b, p_col):
+    """fp.add: canonical modular addition."""
+    return _cond_sub_p(_resolve_single_carries(a + b), p_col)
+
+
+def _sub_mod(a, b, p_col):
+    """fp.sub: canonical modular subtraction."""
+    g = (a < b).astype(_u32)
+    pr = (a == b).astype(_u32)
+    borrow_in, borrow_out = _propagate(g, pr)
+    d = (a + _u32(1 << LIMB_BITS) - b - borrow_in) & MASK
+    dp = _resolve_single_carries(d + jnp.broadcast_to(p_col, d.shape))
+    return jnp.where(borrow_out != 0, dp, d)
+
+
+def _mont_core(a, b, np_col, p_col):
+    """Full Montgomery product on (30, N) values; canonical output.
+    Same algorithm + overflow bounds as fp.mont_mul."""
+    n_lanes = a.shape[1]
+    # U = a conv b (59 rows): u[i:i+30] += a[i] * b
+    u = jnp.zeros((_WIDE, n_lanes), _u32)
+    for i in range(NLIMBS):
+        u = u + jnp.pad(a[i : i + 1, :] * b, ((i, _WIDE - NLIMBS - i), (0, 0)))
+    # two widening passes: limbs <= MASK + ~64, width 61
+    u = _carry_widen(_carry_widen(u, _WIDE + 1), _WIDE + 2)
+
+    # mu = (U mod R) * N' mod R (truncated conv, 30 rows)
+    u_low = u[:NLIMBS]
+    mu = jnp.zeros((NLIMBS, n_lanes), _u32)
+    for i in range(NLIMBS):
+        mu = mu + jnp.pad(
+            u_low[i : i + 1, :] * np_col[: NLIMBS - i], ((i, 0), (0, 0))
+        )
+    mu = _carry_trunc(_carry_trunc(mu))
+
+    # T = U + mu * p (conv adds rows i..i+29 <= 59; width stays 61)
+    t = u
+    for i in range(NLIMBS):
+        t = t + jnp.pad(
+            mu[i : i + 1, :] * p_col, ((i, _WIDE + 2 - NLIMBS - i), (0, 0))
+        )
+    # limbs < 2^31 + small: two passes then exact resolve (width 63)
+    t = _carry_widen(_carry_widen(t, _WIDE + 3), _WIDE + 4)
+    t = _resolve_single_carries(t)
+    res = t[NLIMBS : 2 * NLIMBS]                       # T / R in [0, 2p)
+    return _cond_sub_p(res, p_col)
+
+
+# --- kernels ----------------------------------------------------------------
+
+
+def _mont_mul_kernel(a_ref, b_ref, np_ref, p_ref, o_ref):
+    o_ref[...] = _mont_core(
+        a_ref[...], b_ref[...], np_ref[...], p_ref[...]
+    )
+
+
+def _f2_mul_kernel(a0_ref, a1_ref, b0_ref, b1_ref, np_ref, p_ref, c0_ref, c1_ref):
+    """Fused Fp2 Karatsuba multiply (tower.f2_mul: 3 products + the
+    pre-adds and post-subs, zero intermediate HBM traffic)."""
+    a0, a1 = a0_ref[...], a1_ref[...]
+    b0, b1 = b0_ref[...], b1_ref[...]
+    np_col, p_col = np_ref[...], p_ref[...]
+    lo_a = _add_mod(a0, a1, p_col)
+    lo_b = _add_mod(b0, b1, p_col)
+    t0 = _mont_core(a0, b0, np_col, p_col)
+    t1 = _mont_core(a1, b1, np_col, p_col)
+    t2 = _mont_core(lo_a, lo_b, np_col, p_col)
+    c0_ref[...] = _sub_mod(t0, t1, p_col)
+    c1_ref[...] = _sub_mod(_sub_mod(t2, t0, p_col), t1, p_col)
+
+
+def _f2_sqr_kernel(a0_ref, a1_ref, np_ref, p_ref, c0_ref, c1_ref):
+    """Fused Fp2 square: (a0+a1)(a0-a1), 2*a0*a1."""
+    a0, a1 = a0_ref[...], a1_ref[...]
+    np_col, p_col = np_ref[...], p_ref[...]
+    s = _add_mod(a0, a1, p_col)
+    d = _sub_mod(a0, a1, p_col)
+    t0 = _mont_core(s, d, np_col, p_col)
+    t1 = _mont_core(a0, a1, np_col, p_col)
+    c0_ref[...] = t0
+    c1_ref[...] = _add_mod(t1, t1, p_col)
+
+
+def _mont_mul_limbs_first(a2T, b2T, *, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    n = a2T.shape[1]
+    return pl.pallas_call(
+        _mont_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((NLIMBS, n), _u32),
+        grid=(n // _BLOCK,),
+        in_specs=[
+            pl.BlockSpec((NLIMBS, _BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((NLIMBS, _BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((NLIMBS, 1), lambda i: (0, 0)),
+            pl.BlockSpec((NLIMBS, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((NLIMBS, _BLOCK), lambda i: (0, i)),
+        interpret=interpret,
+    )(a2T, b2T, jnp.asarray(_NPRIME_NP)[:, None], jnp.asarray(_P_NP)[:, None])
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
+    """Drop-in fused replacement for fp.mont_mul (canonical in/out,
+    ``(..., NLIMBS)`` convention; boundary transposes are fused away by
+    XLA).  `interpret=True` runs the Pallas interpreter (CPU tests)."""
+    a, b = jnp.broadcast_arrays(a, b)
+    aT, lead, n = _prep(a)
+    bT, _, _ = _prep(b)
+    return _unprep(_mont_mul_limbs_first(aT, bT, interpret=interpret), lead, n)
+
+
+# --- f2-level fused entry points (consumed by tower.f2_mul/f2_sqr) ----------
+
+
+def _prep(x):
+    """(..., 30) -> padded (30, n) transposed view + restore info."""
+    lead = x.shape[:-1]
+    x2 = x.reshape((-1, NLIMBS))
+    n = x2.shape[0]
+    pad = (-n) % _BLOCK
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    return x2.T, lead, n
+
+
+def _unprep(outT, lead, n):
+    out = outT.T
+    if out.shape[0] != n:
+        out = out[:n]
+    return out.reshape((*lead, NLIMBS))
+
+
+def _consts():
+    return jnp.asarray(_NPRIME_NP)[:, None], jnp.asarray(_P_NP)[:, None]
+
+
+def _limb_specs(n_data: int):
+    from jax.experimental import pallas as pl
+
+    data = [pl.BlockSpec((NLIMBS, _BLOCK), lambda i: (0, i)) for _ in range(n_data)]
+    consts = [pl.BlockSpec((NLIMBS, 1), lambda i: (0, 0)) for _ in range(2)]
+    return data + consts
+
+
+def f2_mul(a, b, *, interpret: bool = False):
+    """Fused tower.f2_mul: ((..,30),(..,30)) x 2 -> 2-tuple."""
+    from jax.experimental import pallas as pl
+
+    a0, a1, b0, b1 = jnp.broadcast_arrays(a[0], a[1], b[0], b[1])
+    a0T, lead, n = _prep(a0)
+    a1T, _, _ = _prep(a1)
+    b0T, _, _ = _prep(b0)
+    b1T, _, _ = _prep(b1)
+    npc, pc = _consts()
+    width = a0T.shape[1]
+    shape = jax.ShapeDtypeStruct((NLIMBS, width), _u32)
+    out_spec = pl.BlockSpec((NLIMBS, _BLOCK), lambda i: (0, i))
+    c0T, c1T = pl.pallas_call(
+        _f2_mul_kernel,
+        out_shape=(shape, shape),
+        grid=(width // _BLOCK,),
+        in_specs=_limb_specs(4),
+        out_specs=(out_spec, out_spec),
+        interpret=interpret,
+    )(a0T, a1T, b0T, b1T, npc, pc)
+    return _unprep(c0T, lead, n), _unprep(c1T, lead, n)
+
+
+def f2_sqr(a, *, interpret: bool = False):
+    """Fused tower.f2_sqr."""
+    from jax.experimental import pallas as pl
+
+    a0, a1 = jnp.broadcast_arrays(a[0], a[1])
+    a0T, lead, n = _prep(a0)
+    a1T, _, _ = _prep(a1)
+    npc, pc = _consts()
+    width = a0T.shape[1]
+    shape = jax.ShapeDtypeStruct((NLIMBS, width), _u32)
+    out_spec = pl.BlockSpec((NLIMBS, _BLOCK), lambda i: (0, i))
+    c0T, c1T = pl.pallas_call(
+        _f2_sqr_kernel,
+        out_shape=(shape, shape),
+        grid=(width // _BLOCK,),
+        in_specs=_limb_specs(2),
+        out_specs=(out_spec, out_spec),
+        interpret=interpret,
+    )(a0T, a1T, npc, pc)
+    return _unprep(c0T, lead, n), _unprep(c1T, lead, n)

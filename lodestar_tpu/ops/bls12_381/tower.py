@@ -131,7 +131,12 @@ def f2_dbl(a):
 
 
 def f2_mul(a, b):
-    """Karatsuba: one 3-way stacked mont_mul."""
+    """Karatsuba: fused pallas kernel on TPU, one 3-way stacked mont_mul
+    on the XLA path."""
+    if fp._use_pallas():
+        from . import pallas_fp
+
+        return pallas_fp.f2_mul(a, b)
     lo = (fp.add(a[0], a[1]), fp.add(b[0], b[1]))
     A = jnp.stack([a[0], a[1], lo[0]])
     B = jnp.stack([b[0], b[1], lo[1]])
@@ -141,6 +146,10 @@ def f2_mul(a, b):
 
 
 def f2_sqr(a):
+    if fp._use_pallas():
+        from . import pallas_fp
+
+        return pallas_fp.f2_sqr(a)
     A = jnp.stack([fp.add(a[0], a[1]), a[0]])
     B = jnp.stack([fp.sub(a[0], a[1]), a[1]])
     T = fp.mont_mul(A, B)

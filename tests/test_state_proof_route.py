@@ -1,0 +1,60 @@
+"""State-proof REST route (reference api/src/beacon/routes/proof.ts):
+the served branch must verify against the served state root.
+"""
+import asyncio
+
+import pytest
+
+from lodestar_tpu.params import ACTIVE_PRESET_NAME
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+
+def test_state_proof_route_verifies():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from lodestar_tpu.api.server import BeaconRestApiServer
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.chain.clock import LocalClock
+    from lodestar_tpu.config import minimal_chain_config as cfg
+    from lodestar_tpu.db import BeaconDb
+    from lodestar_tpu.state_transition.util.genesis import init_dev_state
+    from lodestar_tpu.state_transition.util.merkle import is_valid_merkle_branch
+
+    async def go():
+        _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+        chain = BeaconChain(
+            cfg, BeaconDb(), anchor,
+            clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=lambda: 0.0),
+        )
+        api = BeaconRestApiServer(chain, chain.db)
+        client = TestClient(TestServer(api.app))
+        await client.start_server()
+        try:
+            resp = await client.get(
+                "/eth/v1/beacon/proof/state/head?path=finalized_checkpoint.root"
+            )
+            assert resp.status == 200
+            data = (await resp.json())["data"]
+            ok = is_valid_merkle_branch(
+                bytes.fromhex(data["leaf"][2:]),
+                [bytes.fromhex(b[2:]) for b in data["branch"]],
+                data["depth"],
+                data["index"],
+                bytes.fromhex(data["state_root"][2:]),
+            )
+            assert ok
+            # bad path -> 400; missing path -> 400
+            assert (
+                await client.get("/eth/v1/beacon/proof/state/head?path=nope")
+            ).status == 400
+            assert (
+                await client.get("/eth/v1/beacon/proof/state/head")
+            ).status == 400
+        finally:
+            await client.close()
+            await chain.close()
+
+    asyncio.run(go())
