@@ -23,6 +23,10 @@ from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_set
 from .interface import VerifyOptions
 from .metrics import BlsPoolMetrics
 
+# Default job size matches the reference's per-worker cap (index.ts:39).
+# On TPU the Pallas kernels keep batch latency nearly flat to ~512 sets,
+# so the verifier accepts a larger cap via the constructor for
+# throughput-bound deployments (sync, bursty gossip).
 MAX_SIGNATURE_SETS_PER_JOB = 128
 MAX_BUFFERED_SIGS = 32
 MAX_BUFFER_WAIT_MS = 100
@@ -38,13 +42,19 @@ class _BufferedJob:
 class DeviceBlsVerifier:
     """Batched device verification behind the IBlsVerifier boundary."""
 
-    def __init__(self, metrics: Optional[BlsPoolMetrics] = None, _backend=None):
+    def __init__(
+        self,
+        metrics: Optional[BlsPoolMetrics] = None,
+        _backend=None,
+        max_sets_per_job: int = MAX_SIGNATURE_SETS_PER_JOB,
+    ):
         # _backend injection point for tests (defaults to the jit kernels)
         if _backend is None:
             from lodestar_tpu.ops.bls12_381 import verify as dv
 
             _backend = dv
         self._dv = _backend
+        self._max_sets_per_job = max_sets_per_job
         self._buffer: List[_BufferedJob] = []
         self._buffer_sigs = 0
         self._flush_handle: Optional[asyncio.TimerHandle] = None
@@ -67,13 +77,13 @@ class DeviceBlsVerifier:
         if opts.verify_on_main_thread:
             return all(verify_signature_set(s) for s in sets)
 
-        if opts.batchable and len(sets) <= MAX_SIGNATURE_SETS_PER_JOB:
+        if opts.batchable and len(sets) <= self._max_sets_per_job:
             return await self._enqueue(list(sets))
 
         # non-batchable or oversized: dispatch now, chunked to job size
         results = []
-        for i in range(0, len(sets), MAX_SIGNATURE_SETS_PER_JOB):
-            chunk = list(sets[i : i + MAX_SIGNATURE_SETS_PER_JOB])
+        for i in range(0, len(sets), self._max_sets_per_job):
+            chunk = list(sets[i : i + self._max_sets_per_job])
             results.append(await self._run_job([_make_job(chunk)]))
         return all(results)
 
@@ -121,7 +131,7 @@ class DeviceBlsVerifier:
         packs: List[List[_BufferedJob]] = [[]]
         count = 0
         for job in jobs:
-            if count + len(job.sets) > MAX_SIGNATURE_SETS_PER_JOB and packs[-1]:
+            if count + len(job.sets) > self._max_sets_per_job and packs[-1]:
                 packs.append([])
                 count = 0
             packs[-1].append(job)

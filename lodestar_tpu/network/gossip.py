@@ -121,12 +121,16 @@ class Eth2Gossip:
     dedup (the Eth2Gossipsub role over the in-process fabric)."""
 
     def __init__(self, endpoint: Endpoint, fork_digest: bytes):
+        from .gossip_scoring import GossipPeerScore
+
         self.endpoint = endpoint
         self.fork_digest = fork_digest
         self._queues: Dict[str, JobItemQueue] = {}
         self._seen_ids = _BoundedSeen()
         self._seen_fast_ids = _BoundedSeen()
         self.stats = GossipStats()
+        # gossipsub v1.1 peer scoring (scoringParameters.ts)
+        self.peer_score = GossipPeerScore()
 
     def _topic(self, gossip_type: GossipType, subnet: Optional[int] = None) -> str:
         name = gossip_type.value + (f"_{subnet}" if subnet is not None else "")
@@ -168,6 +172,11 @@ class Eth2Gossip:
             if fast_id in self._seen_fast_ids:
                 self.stats.duplicates += 1
                 return
+            # graylisted peers' fresh messages are ignored (gossipsub
+            # graylistThreshold); checked after dedup so duplicates — the
+            # common case — never pay the score lookup
+            if self.peer_score.should_graylist(from_peer):
+                return
             self._seen_fast_ids.add(fast_id)
             msg_id = compute_message_id(topic_, raw)
             if msg_id in self._seen_ids:
@@ -179,12 +188,26 @@ class Eth2Gossip:
                 obj = ssz_type.deserialize(snappy_decompress(raw))
             except Exception:
                 self.stats.invalid += 1
+                self.peer_score.on_invalid_message(from_peer, topic_)
                 return
+            self.peer_score.on_first_delivery(from_peer, topic_)
             fut = queue.push((from_peer, obj))
 
             def _done(f):
-                if f.cancelled() or f.exception() is not None:
-                    self.stats.invalid += 1
+                from lodestar_tpu.utils.queue import QueueFullError
+
+                if f.cancelled():
+                    return  # shutdown/abort: not the sender's fault
+                e = f.exception()
+                if e is None:
+                    return
+                self.stats.invalid += 1
+                if isinstance(e, QueueFullError):
+                    # local backpressure, NOT peer misbehaviour — scoring
+                    # it would graylist honest peers exactly when this
+                    # node is overloaded
+                    return
+                self.peer_score.on_invalid_message(from_peer, topic_)
 
             fut.add_done_callback(_done)
 

@@ -1,0 +1,175 @@
+"""Gossipsub peer scoring (reference:
+beacon-node/src/network/gossip/scoringParameters.ts, which parameterizes
+gossipsub v1.1's score function).
+
+The score model follows the gossipsub v1.1 spec shape, reduced to the
+terms the reference actually tunes for eth2:
+
+  per-topic:   P2 first-message deliveries (capped, decaying, positive)
+               P4 invalid messages         (squared, decaying, negative)
+  per-peer:    P7 behaviour penalty        (squared, decaying, negative)
+  topic score = weight * (w2*P2 + w4*P4^2), clipped below at topic floor
+
+Topic weights mirror the reference's split: blocks are worth more than
+aggregates, aggregates more than per-subnet attestations (the
+beacon_attestation_subnet weight there is divided across 64 subnets).
+
+Scores decay toward zero on a fixed interval (`decay()` — the reference
+runs decayInterval=12s).  `score()` feeds the same accept/graylist
+thresholds gossipsub uses; the Network's heartbeat disconnects peers
+below `gossip_threshold`.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict
+
+# thresholds (scoringParameters.ts gossipsubThresholds)
+GOSSIP_THRESHOLD = -4000.0
+PUBLISH_THRESHOLD = -8000.0
+GRAYLIST_THRESHOLD = -16000.0
+
+# decay per decay-interval tick
+FIRST_DELIVERY_DECAY = 0.99
+INVALID_DECAY = 0.97
+BEHAVIOUR_DECAY = 0.986
+
+FIRST_DELIVERY_CAP = 40.0
+BEHAVIOUR_PENALTY_THRESHOLD = 6.0
+
+
+@dataclass
+class TopicParams:
+    weight: float
+    first_delivery_weight: float = 1.0
+    invalid_weight: float = -99.0  # squared counter, strongly negative
+
+
+# topic-kind -> params (weights shaped like the reference's)
+DEFAULT_TOPIC_PARAMS: Dict[str, TopicParams] = {
+    "beacon_block": TopicParams(weight=0.5),
+    "beacon_aggregate_and_proof": TopicParams(weight=0.5),
+    "beacon_attestation": TopicParams(weight=1.0 / 64),  # per subnet
+    "sync_committee_contribution_and_proof": TopicParams(weight=0.2),
+    "sync_committee": TopicParams(weight=0.2 / 4),
+    "voluntary_exit": TopicParams(weight=0.05),
+    "proposer_slashing": TopicParams(weight=0.05),
+    "attester_slashing": TopicParams(weight=0.05),
+    "bls_to_execution_change": TopicParams(weight=0.05),
+}
+
+
+def _topic_kind(topic: str) -> str:
+    """`/eth2/<digest>/beacon_attestation_7/ssz_snappy` -> `beacon_attestation`."""
+    parts = topic.split("/")
+    name = parts[3] if len(parts) > 3 else topic
+    base = name.rsplit("_", 1)
+    if len(base) == 2 and base[1].isdigit():
+        return base[0]
+    return name
+
+
+@dataclass
+class _PeerTopicStats:
+    first_deliveries: float = 0.0
+    invalid: float = 0.0
+
+
+@dataclass
+class _PeerStats:
+    topics: Dict[str, _PeerTopicStats] = field(default_factory=dict)
+    behaviour_penalty: float = 0.0
+
+
+class GossipPeerScore:
+    """Per-peer gossip score register (PeerScore role inside gossipsub)."""
+
+    def __init__(self, params: Dict[str, TopicParams] = None):
+        self.params = params or DEFAULT_TOPIC_PARAMS
+        self._peers: Dict[str, _PeerStats] = {}
+
+    def _peer(self, peer_id: str) -> _PeerStats:
+        if peer_id not in self._peers:
+            self._peers[peer_id] = _PeerStats()
+        return self._peers[peer_id]
+
+    def _topic(self, peer_id: str, topic: str) -> _PeerTopicStats:
+        p = self._peer(peer_id)
+        if topic not in p.topics:
+            p.topics[topic] = _PeerTopicStats()
+        return p.topics[topic]
+
+    # -- event hooks (called by the gossip router) ------------------------
+
+    def on_first_delivery(self, peer_id: str, topic: str) -> None:
+        t = self._topic(peer_id, topic)
+        t.first_deliveries = min(FIRST_DELIVERY_CAP, t.first_deliveries + 1.0)
+
+    def on_invalid_message(self, peer_id: str, topic: str) -> None:
+        self._topic(peer_id, topic).invalid += 1.0
+
+    def on_behaviour_penalty(self, peer_id: str) -> None:
+        """Protocol misbehaviour outside topic scoring (e.g. flooding)."""
+        self._peer(peer_id).behaviour_penalty += 1.0
+
+    # -- scoring ----------------------------------------------------------
+
+    def score(self, peer_id: str) -> float:
+        p = self._peers.get(peer_id)
+        if p is None:
+            return 0.0
+        total = 0.0
+        for topic, st in p.topics.items():
+            params = self.params.get(_topic_kind(topic))
+            if params is None:
+                continue
+            topic_score = (
+                params.first_delivery_weight * st.first_deliveries
+                + params.invalid_weight * st.invalid * st.invalid
+            )
+            total += params.weight * topic_score
+        excess = p.behaviour_penalty - BEHAVIOUR_PENALTY_THRESHOLD
+        if excess > 0:
+            total += -10.0 * excess * excess
+        return total
+
+    def should_graylist(self, peer_id: str) -> bool:
+        return self.score(peer_id) < GRAYLIST_THRESHOLD
+
+    def below_gossip_threshold(self, peer_id: str) -> bool:
+        return self.score(peer_id) < GOSSIP_THRESHOLD
+
+    def forget(self, peer_id: str) -> None:
+        """Drop a disconnected peer's stats (the reference prunes scores
+        after a retain window; heartbeat calls this on disconnect)."""
+        self._peers.pop(peer_id, None)
+
+    # -- decay loop -------------------------------------------------------
+
+    def decay(self) -> None:
+        """One decay tick (reference decayInterval = 12 s).  Peers whose
+        counters have all decayed to zero are pruned — without this the
+        registry grows with lifetime peer churn."""
+        for pid in list(self._peers):
+            p = self._peers[pid]
+            empty = p.behaviour_penalty == 0.0
+            for topic in list(p.topics):
+                st = p.topics[topic]
+                st.first_deliveries *= FIRST_DELIVERY_DECAY
+                st.invalid *= INVALID_DECAY
+                if st.invalid < 0.01:
+                    st.invalid = 0.0
+                if st.first_deliveries < 0.01:
+                    st.first_deliveries = 0.0
+                if st.invalid == 0.0 and st.first_deliveries == 0.0:
+                    del p.topics[topic]
+                else:
+                    empty = False
+            p.behaviour_penalty *= BEHAVIOUR_DECAY
+            if p.behaviour_penalty < 0.01:
+                p.behaviour_penalty = 0.0
+            elif p.behaviour_penalty:
+                empty = False
+            if empty and not p.topics:
+                del self._peers[pid]

@@ -378,8 +378,14 @@ class Network:
         disconnect bad-score peers, then top up from discovery.  Returns
         the connected-peer count."""
         for pid in list(self.peer_manager.connected_peers()):
-            if self.peer_manager.scores.should_disconnect(pid):
+            if self.peer_manager.scores.should_disconnect(
+                pid
+            ) or self.gossip.peer_score.should_graylist(pid):
                 self.peer_manager.on_disconnect(pid)
+                # scores are retained (not forgotten) so a graylisted
+                # peer that reconnects is still graylisted until its
+                # counters decay; decay() prunes zeroed entries
+        self.gossip.peer_score.decay()
         discovery = getattr(self, "_discovery", None)
         if discovery is not None:
             connected = self.peer_manager.connected_peers()

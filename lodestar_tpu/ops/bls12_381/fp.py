@@ -98,8 +98,26 @@ def _carry_pass(x):
     return (x & MASK) + _shift_up(x >> LIMB_BITS)
 
 
+_SHIFT_CACHE: dict = {}
+
+
 def _shift_lo(x, d: int):
-    """shifted[i] = x[i-d], zero-filled below (toward less significant)."""
+    """shifted[i] = x[i-d], zero-filled below (toward less significant).
+
+    TPU: pad/slice (free sublane/lane moves).  CPU: a cached static
+    shift-matrix dot — XLA:CPU's fusion/simplification passes take ~1s of
+    compile time PER pad-of-slice op, and the Kogge-Stone carry resolves
+    emit several per field op; dots compile in milliseconds there."""
+    if _target_platform() == "cpu":
+        n = x.shape[-1]
+        key = (n, d)
+        m = _SHIFT_CACHE.get(key)
+        if m is None:
+            # cache the NUMPY matrix (a jnp constant created inside one
+            # trace must not be reused across traces — tracer leak)
+            m = np.eye(n, k=d, dtype=np.uint32)
+            _SHIFT_CACHE[key] = m
+        return _dot(x, m)
     pad = [(0, 0)] * (x.ndim - 1) + [(d, 0)]
     return jnp.pad(x[..., : x.shape[-1] - d], pad)
 
@@ -315,28 +333,57 @@ def _carry_trunc(x):
 
 # Pallas-fused mont_mul on TPU backends (3.7x the XLA expression form —
 # see pallas_fp.py); LODESTAR_TPU_PALLAS=0 opts out.  Decided at trace
-# time: CPU (tests, virtual mesh) keeps the XLA path below.
+# time: CPU (tests, virtual mesh) keeps the XLA paths below.
+#
+# Platform detection caveat: under the axon TPU plugin,
+# jax.default_backend() reports "tpu" even in processes whose
+# computations target host (CPU) devices (virtual-mesh dryrun, forced-CPU
+# tests) — so those entry points must set LODESTAR_TPU_FP_PLATFORM=cpu
+# explicitly (tests/conftest.py, __graft_entry__.dryrun_multichip do).
 import os as _os
 
 PALLAS = _os.environ.get("LODESTAR_TPU_PALLAS", "1") != "0"
 
 
-def _use_pallas() -> bool:
-    if not PALLAS:
-        return False
+def _target_platform() -> str:
+    override = _os.environ.get("LODESTAR_TPU_FP_PLATFORM")
+    if override:
+        return override
     try:
-        return jax.default_backend() == "tpu"
+        return jax.default_backend()
     except Exception:
-        return False
+        return "cpu"
+
+
+def _use_pallas() -> bool:
+    return PALLAS and _target_platform() == "tpu"
 
 
 @_flat_leading
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Montgomery product a*b*R^{-1} mod p, canonical output (parallel)."""
+    """Montgomery product a*b*R^{-1} mod p, canonical output.
+
+    Backend dispatch (trace-time):
+      * tpu  -> Pallas fused kernel (pallas_fp.py; bandwidth-optimal)
+      * else -> serial CIOS scan (mont_mul_cios): XLA:CPU compiles the
+        small scan body in seconds, while the parallel pad/concat form
+        below takes *hours* in its fusion/simplification passes (the
+        dryrun's sharded program never finished compiling)
+      * the parallel XLA form stays available as mont_mul_parallel for
+        ablation and as the reference the Pallas kernel is tested against
+    """
     if _use_pallas():
         from . import pallas_fp
 
         return pallas_fp.mont_mul(a, b)
+    if _target_platform() != "tpu":
+        return mont_mul_cios(a, b)
+    return mont_mul_parallel(a, b)
+
+
+@_flat_leading
+def mont_mul_parallel(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """The parallel (no serial limb scan) XLA expression form."""
     # U = a*b: 59 limbs <= 30*8191^2 < 2^31
     u = _conv(a, b, _IDX_FULL)
     # two widening passes: limbs <= 8191 + 31 (=: B1), width 61

@@ -92,7 +92,9 @@ def outlined(fn):
 
     @functools.wraps(fn)
     def wrapper(*args):
-        if jax.default_backend() != "cpu":
+        # platform via fp._target_platform: under the axon plugin
+        # jax.default_backend() misreports "tpu" in CPU-pinned processes
+        if fp._target_platform() != "cpu":
             return fn(*args)
         xs = jax.tree.map(lambda t: t[None], args)
         _, out = jax.lax.scan(lambda c, x: (c, fn(*x)), jnp.uint32(0), xs)
@@ -371,3 +373,26 @@ def f12_frobenius(a, power: int = 1):
         coeffs = _unstack(P, 6)
         out = _from_wcoeffs(coeffs)
     return out
+
+
+# ---------------------------------------------------------------------------
+# CPU compile-time containment: outline the multiplication-bearing tower
+# ops into length-1 scan bodies (see `outlined`).  XLA:CPU's
+# fusion/simplification passes are superlinear in flat-graph size — the
+# full verify program inlined takes 30+ minutes and tens of GB to
+# compile there, while the outlined form keeps every flat region small.
+# On TPU the wrapper no-ops at trace time, leaving the (cached) fused
+# jaxprs byte-identical.
+# ---------------------------------------------------------------------------
+
+f2_mul = outlined(f2_mul)
+f2_sqr = outlined(f2_sqr)
+f2_inv = outlined(f2_inv)
+f6_mul = outlined(f6_mul)
+f6_sqr = outlined(f6_sqr)
+f6_inv = outlined(f6_inv)
+f12_mul = outlined(f12_mul)
+f12_sqr = outlined(f12_sqr)
+f12_inv = outlined(f12_inv)
+# f12_frobenius takes a static int power (not outlineable as a scan
+# input); its body is small once the f2_mul inside it is outlined.

@@ -15,6 +15,9 @@ CPU compiles of the pairing kernels a one-time cost across test runs.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/tpu default
+# the axon plugin can still report default_backend()=="tpu"; pin the fp
+# engine's backend dispatch to the CPU paths explicitly
+os.environ["LODESTAR_TPU_FP_PLATFORM"] = "cpu"
 os.environ.setdefault("LODESTAR_TPU_PRESET", "minimal")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

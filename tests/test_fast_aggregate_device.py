@@ -1,0 +1,35 @@
+"""Device fastAggregateVerify (BASELINE config 2 shape; reference
+bls.test.ts fastAggregateVerify + aggregatePubkeys):
+on-device pubkey aggregation + one 2-pair pairing check, differential
+against the oracle.
+"""
+from lodestar_tpu.crypto.bls import api
+from lodestar_tpu.ops.bls12_381 import verify as dv
+
+
+def _keys(n, base=300):
+    sks = [api.SecretKey.from_bytes((base + i).to_bytes(32, "big")) for i in range(n)]
+    return sks, [sk.to_public_key() for sk in sks]
+
+
+def test_fast_aggregate_verify_device_matches_oracle():
+    msg = b"\x55" * 32
+    sks, pks = _keys(5)
+    agg = api.aggregate_signatures([sk.sign(msg) for sk in sks])
+    assert api.fast_aggregate_verify(pks, msg, agg)
+    assert dv.fast_aggregate_verify_device(pks, msg, agg)
+    # wrong message rejects
+    assert not dv.fast_aggregate_verify_device(pks, b"\x66" * 32, agg)
+    # missing signer rejects
+    assert not dv.fast_aggregate_verify_device(pks[:-1], msg, agg)
+
+
+def test_fast_aggregate_verify_device_edge_cases():
+    msg = b"\x77" * 32
+    sks, pks = _keys(3)
+    agg = api.aggregate_signatures([sk.sign(msg) for sk in sks])
+    assert dv.fast_aggregate_verify_device([], msg, agg) is False
+    # single signer degenerates to plain verify
+    one = sks[0].sign(msg)
+    assert dv.fast_aggregate_verify_device([pks[0]], msg, one)
+    assert not dv.fast_aggregate_verify_device([pks[1]], msg, one)

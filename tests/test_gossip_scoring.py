@@ -1,0 +1,68 @@
+"""Gossipsub v1.1 peer scoring (reference:
+network/gossip/scoringParameters.ts).
+"""
+from lodestar_tpu.network.gossip_scoring import (
+    FIRST_DELIVERY_CAP,
+    GRAYLIST_THRESHOLD,
+    GossipPeerScore,
+    _topic_kind,
+)
+
+TOPIC_BLOCK = "/eth2/01020304/beacon_block/ssz_snappy"
+TOPIC_ATT_7 = "/eth2/01020304/beacon_attestation_7/ssz_snappy"
+
+
+def test_topic_kind_parsing():
+    assert _topic_kind(TOPIC_BLOCK) == "beacon_block"
+    assert _topic_kind(TOPIC_ATT_7) == "beacon_attestation"
+
+
+def test_first_deliveries_positive_and_capped():
+    s = GossipPeerScore()
+    for _ in range(100):
+        s.on_first_delivery("p1", TOPIC_BLOCK)
+    score = s.score("p1")
+    assert 0 < score <= FIRST_DELIVERY_CAP  # weight 0.5, cap 40 -> <= 20
+    # cap: more deliveries don't grow the score
+    s.on_first_delivery("p1", TOPIC_BLOCK)
+    assert s.score("p1") == score
+
+
+def test_invalid_messages_drive_graylist():
+    s = GossipPeerScore()
+    for _ in range(20):
+        s.on_invalid_message("bad", TOPIC_BLOCK)
+    assert s.score("bad") < GRAYLIST_THRESHOLD
+    assert s.should_graylist("bad")
+    # an honest peer on the same topic stays fine
+    s.on_first_delivery("good", TOPIC_BLOCK)
+    assert not s.should_graylist("good")
+
+
+def test_subnet_weight_dilution():
+    s = GossipPeerScore()
+    s.on_invalid_message("a", TOPIC_BLOCK)
+    s.on_invalid_message("b", TOPIC_ATT_7)
+    # per-subnet attestation invalid weighs 1/32nd of a block invalid
+    assert s.score("a") < s.score("b") < 0
+
+
+def test_decay_recovers_scores():
+    s = GossipPeerScore()
+    for _ in range(10):
+        s.on_invalid_message("p", TOPIC_BLOCK)
+    before = s.score("p")
+    for _ in range(400):
+        s.decay()
+    after = s.score("p")
+    assert after > before
+    assert after == 0.0  # counters floor to zero
+
+
+def test_behaviour_penalty_quadratic_past_threshold():
+    s = GossipPeerScore()
+    for _ in range(6):
+        s.on_behaviour_penalty("p")
+    assert s.score("p") == 0.0  # below threshold: no penalty
+    s.on_behaviour_penalty("p")
+    assert s.score("p") < 0.0

@@ -21,13 +21,8 @@ def _rand_fp(n, seed):
 
 
 def _xla_mont_mul(a, b):
-    """The pure-XLA path regardless of backend dispatch."""
-    saved = fp.PALLAS
-    fp.PALLAS = False
-    try:
-        return np.asarray(fp.mont_mul(a, b))
-    finally:
-        fp.PALLAS = saved
+    """The parallel XLA expression form (the kernel's reference)."""
+    return np.asarray(fp.mont_mul_parallel(a, b))
 
 
 def test_pallas_mont_mul_matches_xla():

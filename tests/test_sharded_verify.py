@@ -1,0 +1,27 @@
+"""Multi-device sharded verification (SURVEY §2.5 row 1: pjit/shard_map
+data parallelism over the signature batch with an ICI reduction of the
+Miller products before one shared final exponentiation).
+
+Runs the driver's dryrun entry in-process semantics: the same
+`__graft_entry__.dryrun_multichip` subprocess the driver executes, on the
+8-device virtual CPU mesh.  Shares its XLA cache entry with the driver's
+run, so after the first compile this is cheap.
+"""
+import subprocess
+import sys
+
+import pytest
+
+
+def test_dryrun_multichip_8():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)",
+        ],
+        cwd=".",
+        capture_output=True,
+        timeout=5200,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
