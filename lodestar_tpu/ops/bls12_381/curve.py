@@ -180,6 +180,17 @@ def to_affine(F: FieldOps, pt, f_inv):
 
 # point-batch reduction lives in verify.py (jac_reduce_add — any batch size)
 
+# trace-once caching (opcache.py): group ops are the widest re-traced
+# bodies outside the field layer — a jac_add site binds ~16 field products.
+# F / f_inv are static (hashable vtables / function objects).
+from .opcache import cached as _cached
+
+jac_double = _cached(jac_double, static_argnums=(0,))
+jac_add = _cached(jac_add, static_argnums=(0,))
+scalar_mul_bits = _cached(scalar_mul_bits, static_argnums=(0,))
+to_affine = _cached(to_affine, static_argnums=(0, 2))
+from_affine = _cached(from_affine, static_argnums=(0,))
+
 
 # ---------------------------------------------------------------------------
 # host-side encoding helpers (oracle points -> limb tensors)

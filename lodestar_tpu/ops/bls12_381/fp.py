@@ -500,6 +500,23 @@ def inv(a: jnp.ndarray) -> jnp.ndarray:
     return mont_pow_fixed(a, P - 2)
 
 
+# ---------------------------------------------------------------------------
+# trace-once caching (see opcache.py): every public mutating op's jaxpr is
+# built once per argument shape and replayed — call sites stop paying the
+# pallas-kernel / scan-body re-trace tax that dominated cold program-build
+# time on 1-CPU hosts.
+# ---------------------------------------------------------------------------
+
+from .opcache import cached as _cached
+
+add = _cached(add)
+sub = _cached(sub)
+mont_mul = _cached(mont_mul)
+mont_mul_cios = _cached(mont_mul_cios)
+mont_mul_parallel = _cached(mont_mul_parallel)
+mont_pow_fixed = _cached(mont_pow_fixed, static_argnums=(1,))
+
+
 # host<->device element helpers -------------------------------------------------
 
 

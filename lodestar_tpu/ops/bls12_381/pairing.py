@@ -168,19 +168,23 @@ def final_exponentiation(f):
     # Per-step epilogues computed UNCONDITIONALLY and selected by the step
     # counter: an earlier lax.switch version compiled each of the 5
     # branches as its own optimized subcomputation (~2x of the pairing's
-    # XLA time); the extra ~3 f12_muls per outer step are noise at runtime.
+    # XLA time).  Since conj distributes over mul (conj(p*x) = pc*conj(x)),
+    # every epilogue is pc * y1 * y2 with y1/y2 chosen by selects — TWO
+    # f12_mul in the body instead of four:
+    #   k 0,1: y1 = conj(x)       y2 = 1      (t = conj(p * x))
+    #   k 2:   y1 = frob(x, 1)    y2 = 1
+    #   k 3:   y1 = 1             y2 = 1      (t = conj(p))
+    #   k 4:   y1 = frob(prev,2)  y2 = conj(prev)
     def body(carry, k):
         x, prev = carry
         p = _cyclotomic_pow_abs_x(x)
         pc = tw.f12_conj(p)
-        e01 = tw.f12_conj(tw.f12_mul(p, x))                       # steps 0, 1
-        e2 = tw.f12_mul(pc, tw.f12_frobenius(x, 1))               # step 2
-        e4 = tw.f12_mul(                                           # step 4
-            tw.f12_mul(pc, tw.f12_frobenius(prev, 2)), tw.f12_conj(prev)
-        )
-        out = tw.f12_select(k <= 1, e01, e2)
-        out = tw.f12_select(k == 3, pc, out)                      # step 3
-        out = tw.f12_select(k == 4, e4, out)
+        one = tw.f12_one(shape=jax.tree.leaves(x)[0].shape[:-1])
+        y1 = tw.f12_select(k == 4, tw.f12_frobenius(prev, 2), one)
+        y1 = tw.f12_select(k == 2, tw.f12_frobenius(x, 1), y1)
+        y1 = tw.f12_select(k <= 1, tw.f12_conj(x), y1)
+        y2 = tw.f12_select(k == 4, tw.f12_conj(prev), one)
+        out = tw.f12_mul(tw.f12_mul(pc, y1), y2)
         return (out, x), None
 
     (t4, _), _ = jax.lax.scan(body, (m, m), jnp.arange(5))

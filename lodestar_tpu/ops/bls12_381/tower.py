@@ -376,23 +376,30 @@ def f12_frobenius(a, power: int = 1):
 
 
 # ---------------------------------------------------------------------------
-# CPU compile-time containment: outline the multiplication-bearing tower
-# ops into length-1 scan bodies (see `outlined`).  XLA:CPU's
-# fusion/simplification passes are superlinear in flat-graph size — the
-# full verify program inlined takes 30+ minutes and tens of GB to
-# compile there, while the outlined form keeps every flat region small.
-# On TPU the wrapper no-ops at trace time, leaving the (cached) fused
-# jaxprs byte-identical.
+# Program-build containment, two layers:
+#   * `outlined` (CPU only): length-1 scan bodies keep XLA:CPU's
+#     superlinear fusion/simplification passes fed with small flat
+#     regions (the inlined full pairing takes 30+ min / tens of GB
+#     there).  On TPU the wrapper no-ops.
+#   * `opcache.cached` (all platforms): each op's jaxpr is traced ONCE
+#     per argument shape and replayed at every further call site —
+#     without it, every site re-traces the pallas kernel / scan body
+#     (~0.75 s per f2_mul site on the 1-CPU bench host; build time, not
+#     XLA optimization, dominated the cold-compile blowups of rounds
+#     1-3).  See opcache.py for measurements.
 # ---------------------------------------------------------------------------
 
-f2_mul = outlined(f2_mul)
-f2_sqr = outlined(f2_sqr)
-f2_inv = outlined(f2_inv)
-f6_mul = outlined(f6_mul)
-f6_sqr = outlined(f6_sqr)
-f6_inv = outlined(f6_inv)
-f12_mul = outlined(f12_mul)
-f12_sqr = outlined(f12_sqr)
-f12_inv = outlined(f12_inv)
-# f12_frobenius takes a static int power (not outlineable as a scan
-# input); its body is small once the f2_mul inside it is outlined.
+from .opcache import cached as _cached
+
+f2_mul = _cached(outlined(f2_mul))
+f2_sqr = _cached(outlined(f2_sqr))
+f2_inv = _cached(outlined(f2_inv))
+f6_mul = _cached(outlined(f6_mul))
+f6_sqr = _cached(outlined(f6_sqr))
+f6_inv = _cached(outlined(f6_inv))
+f12_mul = _cached(outlined(f12_mul))
+f12_sqr = _cached(outlined(f12_sqr))
+f12_inv = _cached(outlined(f12_inv))
+f12_frobenius = _cached(f12_frobenius, static_argnums=(1,))
+f12_select = _cached(f12_select)
+f12_conj = _cached(f12_conj)

@@ -41,49 +41,64 @@ _NEG_G1_Y = jnp.asarray(fp.encode_int(_NEG_G1[1]))
 # ---------------------------------------------------------------------------
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+def _rolled_reduce(tree, combine, identity1):
+    """Reduce axis 0 of ``tree`` with ``combine`` via a rolled tree scan.
+
+    Pads the batch to a power of two with ``identity1`` (a 1-element
+    batch of the combine identity), then runs ONE ``combine`` instance
+    inside a log2(B)-step ``lax.scan``: at step s each lane i combines
+    lanes i and i+B/2^(s+1) (data-dependent ``jnp.roll``), so lane 0
+    holds the full reduction at the end.  Lanes past the live prefix
+    carry garbage-but-canonical field elements that never feed the
+    result.  An earlier Python-loop halving emitted O(log B) distinct
+    combine instances and dominated program build + compile time at
+    large B.
+    """
+    n = jax.tree.leaves(tree)[0].shape[0]
+    assert n >= 1, "empty reduction"
+    m = _next_pow2(n)
+    if m == 1:
+        return jax.tree.map(lambda t: t[0], tree)
+    if m != n:
+        pad = jax.tree.map(
+            lambda t: jnp.broadcast_to(t, (m - n, *t.shape[1:])), identity1
+        )
+        tree = jax.tree.map(lambda t, p: jnp.concatenate([t, p]), tree, pad)
+    halves = jnp.asarray([m >> (s + 1) for s in range(m.bit_length() - 1)],
+                         dtype=jnp.int32)
+
+    def body(acc, half):
+        shifted = jax.tree.map(lambda t: jnp.roll(t, -half, axis=0), acc)
+        return combine(acc, shifted), None
+
+    tree, _ = jax.lax.scan(body, tree, halves)
+    return jax.tree.map(lambda t: t[0], tree)
+
+
 def f12_reduce_mul(f, mask=None):
     """Product of a batch of Fp12 values along axis 0, any batch size >= 1.
 
-    Where ``mask`` is False the element is replaced by one.  Pairwise halving
-    (odd tail carried) keeps the number of f12_mul instances O(log B).
-    """
-    n = jax.tree.leaves(f)[0].shape[0]
-    assert n >= 1, "empty reduction"
+    Where ``mask`` is False the element is replaced by one.  One
+    ``f12_mul`` instance total (see ``_rolled_reduce``)."""
     if mask is not None:
         ones = tw.f12_one(shape=jax.tree.leaves(f)[0].shape[:-1])
         f = tw.f12_select(mask, f, ones)
-    while n > 1:
-        half = n // 2
-        a = jax.tree.map(lambda t: t[:half], f)
-        b = jax.tree.map(lambda t: t[half : 2 * half], f)
-        prod = tw.f12_mul(a, b)
-        if n % 2:
-            tail = jax.tree.map(lambda t: t[-1:], f)
-            prod = jax.tree.map(lambda p, t: jnp.concatenate([p, t]), prod, tail)
-            n = half + 1
-        else:
-            n = half
-        f = prod
-    return jax.tree.map(lambda t: t[0], f)
+    return _rolled_reduce(f, tw.f12_mul, tw.f12_one(shape=(1,)))
 
 
 def jac_reduce_add(F, pts):
-    """Sum a batch of Jacobian points along axis 0, any batch size >= 1."""
-    n = jax.tree.leaves(pts)[0].shape[0]
-    assert n >= 1, "empty reduction"
-    while n > 1:
-        half = n // 2
-        a = jax.tree.map(lambda t: t[:half], pts)
-        b = jax.tree.map(lambda t: t[half : 2 * half], pts)
-        s = cv.jac_add(F, a, b)
-        if n % 2:
-            tail = jax.tree.map(lambda t: t[-1:], pts)
-            s = jax.tree.map(lambda p, t: jnp.concatenate([p, t]), s, tail)
-            n = half + 1
-        else:
-            n = half
-        pts = s
-    return jax.tree.map(lambda t: t[0], pts)
+    """Sum a batch of Jacobian points along axis 0, any batch size >= 1.
+
+    One ``jac_add`` instance total; padding identity is the point at
+    infinity (see ``_rolled_reduce``)."""
+    inf1 = jax.tree.map(lambda t: t[:1], cv.inf_like(F, pts))
+    return _rolled_reduce(
+        pts, lambda a, b: cv.jac_add(F, a, b), inf1
+    )
 
 
 # ---------------------------------------------------------------------------

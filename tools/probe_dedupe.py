@@ -1,0 +1,43 @@
+"""Probe: does compile time scale with the NUMBER of identical pallas calls?
+
+Chains K dependent f2_mul calls (same shapes) and times trace/lower/compile.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    K = int(sys.argv[1])
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    from lodestar_tpu.ops.bls12_381 import tower as tw
+
+    rng = np.random.default_rng(0)
+    rnd = lambda: jnp.asarray(rng.integers(0, 8191, size=(B, 30), dtype=np.uint32))
+    a = (rnd(), rnd())
+    b = (rnd(), rnd())
+
+    def fn(a, b):
+        x = a
+        for _ in range(K):
+            x = tw.f2_mul(x, b)
+        return x
+
+    t0 = time.time()
+    tr = jax.jit(fn).trace(a, b)
+    t1 = time.time()
+    lo = tr.lower()
+    t2 = time.time()
+    lo.compile()
+    t3 = time.time()
+    print(f"K={K} B={B}: trace={t1-t0:.1f}s lower={t2-t1:.1f}s compile={t3-t2:.1f}s",
+          flush=True)
+
+
+main()

@@ -1,0 +1,96 @@
+"""Per-component XLA/Mosaic compile-time profiler for the BLS verify program.
+
+Usage: python tools/profile_compile.py <component> [B]
+Components: f2mul, smul1, smul2, jred, b2a, miller, finalexp, verify
+Prints trace/lower/compile seconds + HLO sizes. Fresh (no) persistent cache.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    comp = sys.argv[1]
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+    from lodestar_tpu.ops.bls12_381 import curve as cv, fp, pairing as pr, tower as tw
+    from lodestar_tpu.ops.bls12_381 import verify as dv
+
+    # example data (all valid field elements; correctness not checked here)
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+
+    def rnd_fp(shape):
+        # random canonical-ish limbs (< 2^13); fine for compile profiling
+        return jnp.asarray(rng.integers(0, 8191, size=(*shape, 30), dtype=np.uint32))
+
+    def rnd_f2(shape):
+        return (rnd_fp(shape), rnd_fp(shape))
+
+    pk_aff = (rnd_fp((B,)), rnd_fp((B,)))
+    pk_inf = jnp.zeros((B,), bool)
+    msg_aff = (rnd_f2((B,)), rnd_f2((B,)))
+    msg_inf = jnp.zeros((B,), bool)
+    sig_aff = (rnd_f2((B,)), rnd_f2((B,)))
+    sig_inf = jnp.zeros((B,), bool)
+    active = jnp.ones((B,), bool)
+    bits = jnp.asarray(rng.integers(0, 2, size=(B, 64), dtype=np.uint32))
+
+    if comp == "f2mul":
+        fn = lambda a, b: tw.f2_mul(a, b)
+        args = (rnd_f2((B,)), rnd_f2((B,)))
+    elif comp == "smul1":
+        fn = lambda aff, bits: cv.scalar_mul_bits(cv.F1, cv.from_affine(cv.F1, aff), bits)
+        args = (pk_aff, bits)
+    elif comp == "smul2":
+        fn = lambda aff, bits: cv.scalar_mul_bits(cv.F2, cv.from_affine(cv.F2, aff), bits)
+        args = (sig_aff, bits)
+    elif comp == "jred":
+        fn = lambda aff: dv.jac_reduce_add(cv.F2, cv.from_affine(cv.F2, aff))
+        args = (sig_aff,)
+    elif comp == "b2a":
+        fn = lambda aff: dv.batch_to_affine(cv.F1, cv.from_affine(cv.F1, aff))
+        args = (pk_aff,)
+    elif comp == "miller":
+        fn = lambda q, p: pr.miller_loop(q, p)
+        args = (msg_aff, pk_aff)
+    elif comp == "finalexp":
+        fn = lambda f: pr.final_exponentiation(f)
+        # build an f12 batch of shape () from random
+        f12 = tuple(tuple(rnd_f2(()) for _ in range(3)) for _ in range(2))
+        args = (f12,)
+    elif comp == "verify":
+        fn = dv.verify_signature_sets
+        args = (pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, bits, active)
+    else:
+        raise SystemExit(f"unknown component {comp}")
+
+    t0 = time.time()
+    jfn = jax.jit(fn)
+    traced = jfn.trace(*args)
+    t1 = time.time()
+    lowered = traced.lower()
+    t2 = time.time()
+    try:
+        hlo_len = len(lowered.as_text())
+    except Exception:
+        hlo_len = -1
+    compiled = lowered.compile()
+    t3 = time.time()
+    print(
+        f"RESULT {comp} B={B}: trace={t1-t0:.1f}s lower={t2-t1:.1f}s "
+        f"compile={t3-t2:.1f}s stablehlo_bytes={hlo_len}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

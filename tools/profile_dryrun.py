@@ -1,0 +1,77 @@
+"""Phase-timed replica of __graft_entry__.dryrun_multichip's child.
+
+Run WITHOUT the parent wrapper:
+    python tools/profile_dryrun.py [n_devices]
+Sets the same env as the parent (CPU platform, O0 flags, fp cpu path),
+then times build/trace/lower/compile/run separately.  No persistent cache.
+"""
+import os
+import sys
+import time
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+
+# The axon site hook registers its PJRT plugin from a .pth at interpreter
+# start — env mutation in-process is too late.  Respawn with a clean env.
+if os.environ.get("_LODESTAR_PROFILE_CHILD") != "1":
+    import subprocess
+
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))
+    }
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon_site" not in p
+    )
+    env["_LODESTAR_PROFILE_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LODESTAR_TPU_FP_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n}"
+        " --xla_backend_optimization_level=0"
+        " --xla_llvm_disable_expensive_passes=true"
+    )
+    raise SystemExit(
+        subprocess.run([sys.executable, os.path.abspath(__file__), str(n)],
+                       env=env).returncode
+    )
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+t0 = time.time()
+import __graft_entry__ as g
+from lodestar_tpu.ops.bls12_381 import curve as _cv, verify as dv
+
+(pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active), _ = g._example_batch(n)
+rand = [(2 * i + 3) | 1 for i in range(n)]
+bits = _cv.scalars_to_bits(rand, 16)
+t1 = time.time()
+print(f"build: {t1-t0:.1f}s", flush=True)
+
+devices = jax.devices("cpu")[:n]
+mesh = Mesh(devices, ("sp",))
+shard = NamedSharding(mesh, P("sp"))
+args = jax.tree.map(
+    lambda x: jax.device_put(x, shard),
+    (pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, bits, active),
+)
+jfn = jax.jit(dv.verify_signature_sets)
+t2 = time.time()
+traced = jfn.trace(*args)
+t3 = time.time()
+print(f"trace: {t3-t2:.1f}s", flush=True)
+lowered = traced.lower()
+t4 = time.time()
+print(f"lower: {t4-t3:.1f}s hlo_bytes={len(lowered.as_text())}", flush=True)
+compiled = lowered.compile()
+t5 = time.time()
+print(f"compile: {t5-t4:.1f}s", flush=True)
+ok = bool(compiled(*args))
+t6 = time.time()
+print(f"run: {t6-t5:.1f}s ok={ok}", flush=True)
