@@ -31,7 +31,7 @@ from .curve import (
     g2_to_bytes,
 )
 from .fields import R
-from .hash_to_curve import hash_to_g2
+from .hash_to_curve import hash_to_g2_affine
 
 _NEG_G1_GEN = g1.neg_pt(G1_GEN_JAC)
 _NEG_G1_GEN_AFF = g1.to_affine(_NEG_G1_GEN)
@@ -88,7 +88,7 @@ class SecretKey:
         return PublicKey(g1.to_affine(g1.mul_scalar(G1_GEN_JAC, self.value)))
 
     def sign(self, message: bytes) -> "Signature":
-        h = hash_to_g2(message)
+        h = g2.from_affine(hash_to_g2_affine(message))
         return Signature(g2.to_affine(g2.mul_scalar(h, self.value)))
 
 
@@ -152,7 +152,7 @@ def verify(pk: PublicKey, message: bytes, sig: Signature) -> bool:
         return False
     if not g2_in_subgroup(g2.from_affine(sig.point)):
         return False
-    h = g2.to_affine(hash_to_g2(message))
+    h = hash_to_g2_affine(message)
     return pairing.multi_pairing_is_one(
         [(pk.point, h), (_NEG_G1_GEN_AFF, sig.point)]
     )
@@ -183,7 +183,7 @@ def aggregate_verify(pks: Sequence[PublicKey], messages: Sequence[bytes], sig: S
     if not g2_in_subgroup(g2.from_affine(sig.point)):
         return False
     pairs: List[Tuple[AffineG1, AffineG2]] = [
-        (pk.point, g2.to_affine(hash_to_g2(m))) for pk, m in zip(pks, messages)
+        (pk.point, hash_to_g2_affine(m)) for pk, m in zip(pks, messages)
     ]
     pairs.append((_NEG_G1_GEN_AFF, sig.point))
     return pairing.multi_pairing_is_one(pairs)
@@ -227,7 +227,7 @@ def verify_multiple_signature_sets(
             return False
         if not g2_in_subgroup(g2.from_affine(s.signature.point)):
             return False
-        h = g2.to_affine(hash_to_g2(s.message))
+        h = hash_to_g2_affine(s.message)
         rpk = g1.to_affine(g1.mul_scalar(g1.from_affine(s.public_key.point), r))
         pairs.append((rpk, h))
         sig_acc = g2.add_pts(sig_acc, g2.mul_scalar(g2.from_affine(s.signature.point), r))

@@ -178,3 +178,20 @@ def hash_to_g2(msg: bytes, dst: bytes = CIPHERSUITE_DST) -> JacG2:
     q0 = g2.from_affine(map_to_curve_g2(u0))
     q1 = g2.from_affine(map_to_curve_g2(u1))
     return clear_cofactor_g2(g2.add_pts(q0, q1))
+
+
+def hash_to_g2_affine(msg: bytes, dst: bytes = CIPHERSUITE_DST):
+    """Affine hash_to_curve with the native C fast path when available.
+
+    ~100x the pure-Python pipeline (native/csrc/bls_h2c.c, differential-
+    tested in tests/test_native_h2c.py); the production verification path
+    (ops/bls12_381/verify._encode_sets) hashes every message through
+    here.  Role parity: blst's in-C hash_to_g2 behind @chainsafe/bls."""
+    from lodestar_tpu import native
+
+    if native.has_h2c():
+        try:
+            return native.hash_to_g2_affine(msg, dst)
+        except ValueError:
+            pass  # e.g. message beyond the C buffer cap: uniform fallback
+    return g2.to_affine(hash_to_g2(msg, dst))

@@ -377,6 +377,12 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
         return pallas_fp.mont_mul(a, b)
     if _target_platform() != "tpu":
+        # CPU: CIOS scan by default; LODESTAR_TPU_CPU_PARALLEL_FP=1 selects
+        # the scan-free conv form (fewer, flatter XLA:CPU computations —
+        # compile-time experiment knob, safe either way: both forms are
+        # differential-tested)
+        if _os.environ.get("LODESTAR_TPU_CPU_PARALLEL_FP") == "1":
+            return mont_mul_parallel(a, b)
         return mont_mul_cios(a, b)
     return mont_mul_parallel(a, b)
 

@@ -37,9 +37,15 @@ _CACHE: dict = {}
 
 
 def _env_key():
+    import os
+
     from . import fp
 
-    return (fp._target_platform(), fp._use_pallas())
+    return (
+        fp._target_platform(),
+        fp._use_pallas(),
+        os.environ.get("LODESTAR_TPU_CPU_PARALLEL_FP"),
+    )
 
 
 def _leaf_aval(leaf) -> tuple | None:

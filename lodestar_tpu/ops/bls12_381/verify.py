@@ -57,6 +57,13 @@ def _rolled_reduce(tree, combine, identity1):
     result.  An earlier Python-loop halving emitted O(log B) distinct
     combine instances and dominated program build + compile time at
     large B.
+
+    Runtime tradeoff (deliberate): every step combines across the FULL
+    width, so total lane-combines are B*log2(B) vs the halving tree's
+    ~B.  Below the 512-lane pallas block the extra lanes are padding
+    anyway, and above it the reduction is a small term next to the
+    64-iteration Miller/scalar-mul scans — compile time was the binding
+    constraint (BENCH r1-r3 never finished a cold stage).
     """
     n = jax.tree.leaves(tree)[0].shape[0]
     assert n >= 1, "empty reduction"
@@ -326,15 +333,15 @@ _jit_each = jax.jit(verify_each)
 def _encode_sets(sets, size: int):
     """Oracle SignatureSets -> padded device tensors (host-side).
 
-    Messages are hashed to G2 on host (oracle hash_to_curve); the device
-    consumes affine message points."""
+    Messages are hashed to G2 on host via the native C fast path
+    (hash_to_g2_affine; pure-Python fallback); the device consumes
+    affine message points."""
     from lodestar_tpu.crypto.bls import hash_to_curve as h2c
-    from lodestar_tpu.crypto.bls.curve import g2
 
     pks, msgs, sigs, act = [], [], [], []
     for s in sets:
         pks.append(s.public_key.point)
-        msgs.append(g2.to_affine(h2c.hash_to_g2(s.message)))
+        msgs.append(h2c.hash_to_g2_affine(s.message))
         sigs.append(s.signature.point)
         act.append(True)
     while len(pks) < size:
@@ -381,7 +388,6 @@ def fast_aggregate_verify_device(public_keys, message: bytes, signature) -> bool
     """Host entry: fastAggregateVerify (1 msg, N aggregated pubkeys) on
     device — oracle api.fast_aggregate_verify semantics."""
     from lodestar_tpu.crypto.bls import hash_to_curve as h2c
-    from lodestar_tpu.crypto.bls.curve import g2
 
     if not public_keys:
         return False
@@ -393,7 +399,7 @@ def fast_aggregate_verify_device(public_keys, message: bytes, signature) -> bool
     active = np.zeros(size, dtype=bool)
     active[: len(public_keys)] = True
     pk_aff, pk_inf = cv.encode_g1_affine(pts)
-    msg_pt = g2.to_affine(h2c.hash_to_g2(message))
+    msg_pt = h2c.hash_to_g2_affine(message)
     msg_aff, msg_inf = cv.encode_g2_affine([msg_pt])
     sig_aff, sig_inf = cv.encode_g2_affine([signature.point])
     squeeze = lambda t: jax.tree.map(lambda x: x[0], t)

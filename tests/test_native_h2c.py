@@ -1,0 +1,54 @@
+"""Native C hash-to-curve vs the pure-Python oracle (and, transitively,
+the RFC 9380 vectors the oracle is pinned to in test_bls_oracle.py).
+
+The C path (native/csrc/bls_h2c.c) fills the role blst's in-C hash_to_g2
+plays for the reference client (consumed via @chainsafe/bls at
+packages/beacon-node/src/chain/bls/) — the host-side hot loop of
+signature verification: one hash per gossip attestation.
+"""
+import os
+
+import pytest
+
+from lodestar_tpu import native
+from lodestar_tpu.crypto.bls import hash_to_curve as h2c
+from lodestar_tpu.crypto.bls.curve import g2, g2_in_subgroup
+
+pytestmark = pytest.mark.skipif(
+    not native.has_h2c(), reason="native library unavailable"
+)
+
+
+def test_matches_oracle_random_messages():
+    rnd = os.urandom  # fresh randomness each run: differential, not KAT
+    msgs = [b"", b"abc", b"\x00" * 32, rnd(32), rnd(7), rnd(129)]
+    for msg in msgs:
+        expected = g2.to_affine(h2c.hash_to_g2(msg))
+        got = native.hash_to_g2_affine(msg, h2c.CIPHERSUITE_DST)
+        assert got == expected, msg
+
+
+def test_matches_oracle_alt_dst():
+    dst = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+    for msg in (b"", b"abc", b"abcdef0123456789"):
+        expected = g2.to_affine(h2c.hash_to_g2(msg, dst))
+        got = native.hash_to_g2_affine(msg, dst)
+        assert got == expected, msg
+
+
+def test_output_in_subgroup():
+    pt = native.hash_to_g2_affine(os.urandom(32), h2c.CIPHERSUITE_DST)
+    assert g2_in_subgroup(g2.from_affine(pt))
+
+
+def test_dispatch_used_by_api():
+    # the public affine helper must route through the native path here
+    msg = os.urandom(32)
+    assert h2c.hash_to_g2_affine(msg) == native.hash_to_g2_affine(
+        msg, h2c.CIPHERSUITE_DST
+    )
+
+
+def test_long_dst_and_message_bounds():
+    with pytest.raises(ValueError):
+        native.hash_to_g2_affine(b"x" * 5000, h2c.CIPHERSUITE_DST)

@@ -2,8 +2,12 @@
 
 Run WITHOUT the parent wrapper:
     python tools/profile_dryrun.py [n_devices]
-Sets the same env as the parent (CPU platform, O0 flags, fp cpu path),
-then times build/trace/lower/compile/run separately.  No persistent cache.
+Sets the parent's env (CPU platform, fp cpu path, axon strip) and times
+build/trace/lower/compile/run separately.  No persistent cache.  XLA
+flags beyond the device count come from PROFILE_XLA_EXTRA (empty =
+XLA defaults) — pass the production child's flags explicitly when
+predicting its compile behavior; __graft_entry__ is the source of truth
+for what ships.
 """
 import os
 import sys
@@ -28,11 +32,10 @@ if os.environ.get("_LODESTAR_PROFILE_CHILD") != "1":
     env["_LODESTAR_PROFILE_CHILD"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     env["LODESTAR_TPU_FP_PLATFORM"] = "cpu"
+    extra = os.environ.get("PROFILE_XLA_EXTRA", "")
     env["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={n}"
-        " --xla_backend_optimization_level=0"
-        " --xla_llvm_disable_expensive_passes=true"
-    )
+        f"--xla_force_host_platform_device_count={n} " + extra
+    ).strip()
     raise SystemExit(
         subprocess.run([sys.executable, os.path.abspath(__file__), str(n)],
                        env=env).returncode
