@@ -2,7 +2,7 @@ from .interface import BlsVerifier, VerifyOptions  # noqa: F401
 from .single_thread import SingleThreadBlsVerifier  # noqa: F401
 from .device_pool import (  # noqa: F401
     DeviceBlsVerifier,
-    MAX_BUFFERED_SIGS,
     MAX_BUFFER_WAIT_MS,
     MAX_SIGNATURE_SETS_PER_JOB,
+    REFERENCE_SETS_PER_JOB,
 )

@@ -34,11 +34,22 @@ from .transport import Endpoint, InProcessHub
 
 
 class Network:
-    def __init__(self, hub: InProcessHub, chain, db, peer_id: Optional[str] = None):
+    def __init__(
+        self,
+        hub: Optional[InProcessHub],
+        chain,
+        db,
+        peer_id: Optional[str] = None,
+        endpoint=None,
+    ):
+        """`endpoint` overrides the in-process hub attachment with any
+        Endpoint-surface transport — production passes a
+        wire.WireTransport (TCP + noise + gossip mesh); tests pass the
+        hub double."""
         self.chain = chain
         self.db = db
         signed_block_wire_codec.configure(chain.cfg)
-        self.endpoint = Endpoint(hub, peer_id)
+        self.endpoint = endpoint if endpoint is not None else Endpoint(hub, peer_id)
         self.peer_id = self.endpoint.peer_id
         fork_digest = compute_fork_digest(
             chain.cfg.GENESIS_FORK_VERSION, chain.genesis_validators_root
@@ -394,7 +405,12 @@ class Network:
                     target_peers - len(connected)
                 ):
                     pid = self._resolve_peer(enr)
-                    if pid is None or pid in self.peer_manager.peers:
+                    if asyncio.iscoroutine(pid):  # async resolver: dials TCP
+                        try:
+                            pid = await pid
+                        except Exception:
+                            continue
+                    if pid is None or pid in self.peer_manager.connected_peers():
                         continue
                     try:
                         await self.connect(pid)

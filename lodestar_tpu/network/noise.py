@@ -1,0 +1,213 @@
+"""Noise-XX-style AEAD session handshake for the wire transport.
+
+Role parity: the reference secures every libp2p connection with the
+Noise protocol (@chainsafe/libp2p-noise + as-chacha20poly1305, SURVEY
+§2.3); this module fills that role for the rebuild's TCP transport with
+the same primitive suite (X25519 DH, SHA-256 HKDF chaining, ChaCha20-
+Poly1305 AEAD) and the XX pattern's shape:
+
+    -> e
+    <- e, ee, s, es
+    -> s, se
+
+Both sides authenticate via static X25519 keys; the peer id is derived
+from the remote static key, so a peer cannot claim another's identity
+without its key.  DOCUMENTED DEVIATION (like discovery.py's): this is a
+self-consistent implementation of the pattern, not wire-compatible with
+libp2p-noise's framing (no libp2p handshake payload signatures); both
+ends of every connection run this stack.
+
+Transport framing after the handshake: 4-byte big-endian ciphertext
+length || ChaCha20Poly1305(plaintext), nonce = 4 zero bytes || 8-byte
+little-endian per-direction counter.  A tampered or replayed frame fails
+authentication and tears down the connection.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+_PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256/lodestar-tpu"
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _hkdf2(chaining_key: bytes, input_key_material: bytes) -> tuple:
+    """Noise HKDF with two outputs (RFC 5869 with SHA-256)."""
+    temp = _hmac.new(chaining_key, input_key_material, hashlib.sha256).digest()
+    out1 = _hmac.new(temp, b"\x01", hashlib.sha256).digest()
+    out2 = _hmac.new(temp, out1 + b"\x02", hashlib.sha256).digest()
+    return out1, out2
+
+
+def _pub_bytes(pub: X25519PublicKey) -> bytes:
+    return pub.public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+
+
+class HandshakeError(ConnectionError):
+    pass
+
+
+class _SymmetricState:
+    def __init__(self):
+        self.h = _sha256(_PROTOCOL_NAME)
+        self.ck = self.h
+        self.k: bytes | None = None
+        self.n = 0
+
+    def mix_hash(self, data: bytes) -> None:
+        self.h = _sha256(self.h + data)
+
+    def mix_key(self, ikm: bytes) -> None:
+        self.ck, self.k = _hkdf2(self.ck, ikm)
+        self.n = 0
+
+    def _nonce(self) -> bytes:
+        n = self.n
+        self.n += 1
+        return b"\x00" * 4 + n.to_bytes(8, "little")
+
+    def encrypt_and_hash(self, plaintext: bytes) -> bytes:
+        if self.k is None:
+            self.mix_hash(plaintext)
+            return plaintext
+        ct = ChaCha20Poly1305(self.k).encrypt(self._nonce(), plaintext, self.h)
+        self.mix_hash(ct)
+        return ct
+
+    def decrypt_and_hash(self, ciphertext: bytes) -> bytes:
+        if self.k is None:
+            self.mix_hash(ciphertext)
+            return ciphertext
+        try:
+            pt = ChaCha20Poly1305(self.k).decrypt(
+                self._nonce(), ciphertext, self.h
+            )
+        except Exception as e:
+            raise HandshakeError(f"handshake decrypt failed: {e}") from e
+        self.mix_hash(ciphertext)
+        return pt
+
+    def split(self) -> tuple:
+        k1, k2 = _hkdf2(self.ck, b"")
+        return k1, k2
+
+
+@dataclass
+class NoiseSession:
+    """Post-handshake transport state for one direction pair."""
+
+    send_key: bytes
+    recv_key: bytes
+    remote_static: bytes  # raw 32-byte remote static public key
+    _send_n: int = 0
+    _recv_n: int = 0
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        nonce = b"\x00" * 4 + self._send_n.to_bytes(8, "little")
+        self._send_n += 1
+        return ChaCha20Poly1305(self.send_key).encrypt(nonce, plaintext, b"")
+
+    def decrypt(self, ciphertext: bytes) -> bytes:
+        nonce = b"\x00" * 4 + self._recv_n.to_bytes(8, "little")
+        self._recv_n += 1
+        try:
+            return ChaCha20Poly1305(self.recv_key).decrypt(nonce, ciphertext, b"")
+        except Exception as e:
+            raise HandshakeError(f"frame decrypt failed: {e}") from e
+
+
+async def _read_msg(reader) -> bytes:
+    hdr = await reader.readexactly(2)
+    return await reader.readexactly(int.from_bytes(hdr, "big"))
+
+
+def _write_msg(writer, data: bytes) -> None:
+    writer.write(len(data).to_bytes(2, "big") + data)
+
+
+async def initiator_handshake(reader, writer, static_priv: X25519PrivateKey) -> NoiseSession:
+    """Run the XX pattern as initiator; returns the transport session."""
+    st = _SymmetricState()
+    e = X25519PrivateKey.generate()
+    e_pub = _pub_bytes(e.public_key())
+    s_pub = _pub_bytes(static_priv.public_key())
+
+    # -> e
+    st.mix_hash(e_pub)
+    _write_msg(writer, e_pub)
+    await writer.drain()
+
+    # <- e, ee, s, es
+    msg = await _read_msg(reader)
+    if len(msg) < 32 + 48:
+        raise HandshakeError("short handshake response")
+    re_pub = msg[:32]
+    st.mix_hash(re_pub)
+    st.mix_key(e.exchange(X25519PublicKey.from_public_bytes(re_pub)))  # ee
+    rs_ct = msg[32 : 32 + 48]
+    rs_pub = st.decrypt_and_hash(rs_ct)  # s
+    st.mix_key(e.exchange(X25519PublicKey.from_public_bytes(rs_pub)))  # es
+    _ = st.decrypt_and_hash(msg[32 + 48 :])  # (empty payload)
+
+    # -> s, se
+    s_ct = st.encrypt_and_hash(s_pub)
+    st.mix_key(static_priv.exchange(X25519PublicKey.from_public_bytes(re_pub)))  # se
+    payload_ct = st.encrypt_and_hash(b"")
+    _write_msg(writer, s_ct + payload_ct)
+    await writer.drain()
+
+    k1, k2 = st.split()
+    return NoiseSession(send_key=k1, recv_key=k2, remote_static=rs_pub)
+
+
+async def responder_handshake(reader, writer, static_priv: X25519PrivateKey) -> NoiseSession:
+    """Run the XX pattern as responder; returns the transport session."""
+    st = _SymmetricState()
+    e = X25519PrivateKey.generate()
+    e_pub = _pub_bytes(e.public_key())
+    s_pub = _pub_bytes(static_priv.public_key())
+
+    # -> e
+    msg = await _read_msg(reader)
+    if len(msg) != 32:
+        raise HandshakeError("bad handshake initiation")
+    re_pub = msg
+    st.mix_hash(re_pub)
+
+    # <- e, ee, s, es
+    st.mix_hash(e_pub)
+    st.mix_key(e.exchange(X25519PublicKey.from_public_bytes(re_pub)))  # ee
+    s_ct = st.encrypt_and_hash(s_pub)
+    st.mix_key(static_priv.exchange(X25519PublicKey.from_public_bytes(re_pub)))  # es
+    payload_ct = st.encrypt_and_hash(b"")
+    _write_msg(writer, e_pub + s_ct + payload_ct)
+    await writer.drain()
+
+    # -> s, se
+    msg = await _read_msg(reader)
+    if len(msg) < 48:
+        raise HandshakeError("short handshake finish")
+    rs_pub = st.decrypt_and_hash(msg[:48])  # s
+    st.mix_key(e.exchange(X25519PublicKey.from_public_bytes(rs_pub)))  # se
+    _ = st.decrypt_and_hash(msg[48:])
+
+    k1, k2 = st.split()
+    return NoiseSession(send_key=k2, recv_key=k1, remote_static=rs_pub)
+
+
+def peer_id_from_static(pub_raw: bytes) -> str:
+    """Derive the transport peer id from a raw static public key."""
+    return "16U" + hashlib.sha256(b"lodestar-tpu-peer-id" + pub_raw).hexdigest()[:32]

@@ -1,0 +1,316 @@
+"""Device (TPU) hash-to-curve for G2 — batched SSWU + isogeny + cofactor.
+
+Everything after RFC 9380's expand_message_xmd is field arithmetic, and
+at batch width it belongs on the accelerator next to the pairing (the
+reference gets the whole pipeline natively inside blst; VERDICT r3
+ranked the host-bound hash path its #3 gap).  The host supplies the
+``hash_to_field`` outputs u_ij (two Fp2 draws per message — SHA-256 via
+native C + one bigint reduction each); the device maps them to G2:
+
+    sswu (branchless, both-candidate sqrt) -> 3-isogeny -> Q0 + Q1
+    -> Budroni-Pintore cofactor clearing (psi-based)
+
+Design notes for the TPU shape of each stage:
+  * All branching in the RFC algorithms (sqrt success, tv1 == 0, sign
+    fix) becomes compute-both + ``select`` — constant-shape SPMD.
+  * The two candidate square roots (gx1, gx2) and the two maps per
+    message are STACKED into the batch axis, so each fixed-exponent pow
+    compiles ONCE and runs at width 4B instead of four instances.
+  * Inversions use Fermat pows (batched, 96 scan steps) rather than the
+    Montgomery prefix trick (2B sequential scan steps): on TPU the wide
+    parallel pow beats the long sequential scan for any real batch.
+  * Cofactor clearing's two [|x|]-multiplications reuse the SAME
+    ``scalar_mul_bits`` instance (and its trace/compile cache entry) as
+    batch verification's r_i*sig_i multiplication.
+
+Differential-tested against the Python oracle in
+tests/test_device_h2c.py (the oracle itself is pinned to the RFC 9380
+vectors in tests/test_bls_oracle.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lodestar_tpu.crypto.bls import hash_to_curve as _oh2c
+from lodestar_tpu.crypto.bls.curve import PSI_CX, PSI_CY
+from lodestar_tpu.crypto.bls.fields import ABS_X, P
+from . import curve as cv, fp, tower as tw
+from .opcache import cached as _cached
+
+# ---------------------------------------------------------------------------
+# constants (encoded from the oracle's tables at import time)
+# ---------------------------------------------------------------------------
+
+_SSWU_A = tw.encode_fp2(_oh2c.SSWU_A)
+_SSWU_B = tw.encode_fp2(_oh2c.SSWU_B)
+_SSWU_Z = tw.encode_fp2(_oh2c.SSWU_Z)
+_NEG_B_DIV_A = tw.encode_fp2(
+    _oh2c.f2_mul(_oh2c.f2_neg(_oh2c.SSWU_B), _oh2c.f2_inv(_oh2c.SSWU_A))
+)
+_B_DIV_ZA = tw.encode_fp2(
+    _oh2c.f2_mul(
+        _oh2c.SSWU_B, _oh2c.f2_inv(_oh2c.f2_mul(_oh2c.SSWU_Z, _oh2c.SSWU_A))
+    )
+)
+_XNUM = [tw.encode_fp2(c) for c in _oh2c.XNUM]
+_XDEN = [tw.encode_fp2(c) for c in _oh2c.XDEN]
+_YNUM = [tw.encode_fp2(c) for c in _oh2c.YNUM]
+_YDEN = [tw.encode_fp2(c) for c in _oh2c.YDEN]
+_PSI_CX = tw.encode_fp2(PSI_CX)
+_PSI_CY = tw.encode_fp2(PSI_CY)
+
+_ABS_X_BITS = np.array(
+    [int(b) for b in bin(ABS_X)[2:]], dtype=np.uint32
+)  # MSB-first, 64 bits
+
+
+def _bc2(c, shape):
+    """Broadcast an encoded Fp2 constant over leading batch axes."""
+    return jax.tree.map(lambda t: jnp.broadcast_to(t, (*shape, t.shape[-1])), c)
+
+
+# ---------------------------------------------------------------------------
+# batched fixed-exponent Fp2 pow + branchless sqrt
+# ---------------------------------------------------------------------------
+
+
+def f2_pow_fixed(a, e: int):
+    """a^e over Fp2, 4-bit fixed window (mirrors fp.mont_pow_fixed)."""
+    shape = a[0].shape[:-1]
+    one = tw.f2_one(shape=shape)
+    if e == 0:
+        return one
+    ndigits = (e.bit_length() + 3) // 4
+    digits = np.array(
+        [(e >> (4 * (ndigits - 1 - i))) & 0xF for i in range(ndigits)],
+        dtype=np.int32,
+    )
+    pows = [one, a, tw.f2_sqr(a)]
+    for _ in range(13):
+        pows.append(tw.f2_mul(pows[-1], a))
+    table = jax.tree.map(lambda *xs: jnp.stack(xs), *pows)  # (16, ...)
+
+    def body(acc, d):
+        for _ in range(4):
+            acc = tw.f2_sqr(acc)
+        sel = jax.tree.map(lambda t: t[d], table)
+        return tw.f2_mul(acc, sel), None
+
+    acc, _ = jax.lax.scan(body, one, jnp.asarray(digits))
+    return acc
+
+
+f2_pow_fixed = _cached(f2_pow_fixed, static_argnums=(1,))
+
+
+def f2_inv_pow(a):
+    """Batched Fp2 inversion via one Fermat pow on the norm (inv(0)=0).
+
+    On TPU this replaces the sequential Montgomery-trick prefix scan:
+    96 wide scan steps instead of 2B dependent multiplies."""
+    t = fp.mont_mul(
+        jnp.stack([a[0], a[1]]), jnp.stack([a[0], a[1]])
+    )
+    norm = fp.add(t[0], t[1])
+    ninv = fp.mont_pow_fixed(norm, P - 2)
+    u = fp.mont_mul(jnp.stack([a[0], a[1]]), jnp.stack([ninv, ninv]))
+    return (u[0], fp.neg(u[1]))
+
+
+f2_inv_pow = _cached(f2_inv_pow)
+
+
+def f2_sqrt_both(a):
+    """Branchless Adj-Rodriguez sqrt (p = 3 mod 4): returns (root, ok).
+
+    Computes both algorithm branches and selects; `ok` is False where
+    `a` is a non-residue (root is then garbage-but-canonical)."""
+    shape = a[0].shape[:-1]
+    a1 = f2_pow_fixed(a, (P - 3) // 4)
+    x0 = tw.f2_mul(a1, a)
+    alpha = tw.f2_mul(a1, x0)
+    minus_one = tw.f2_neg(tw.f2_one(shape=shape))
+    is_m1 = tw.f2_eq(alpha, minus_one)
+    cand_u = (fp.neg(x0[1]), x0[0])  # u * x0
+    one_alpha = tw.f2_add(tw.f2_one(shape=shape), alpha)
+    b = f2_pow_fixed(one_alpha, (P - 1) // 2)
+    cand_b = tw.f2_mul(b, x0)
+    x = tw.f2_select(is_m1, cand_u, cand_b)
+    ok = tw.f2_eq(tw.f2_sqr(x), a)
+    return x, ok
+
+
+def _f2_sgn0(a):
+    """RFC 9380 sgn0 on device (parity of the canonical integer)."""
+    p0 = fp.from_mont(a[0])
+    p1 = fp.from_mont(a[1])
+    sign_0 = p0[..., 0] & 1
+    zero_0 = fp.is_zero(p0)
+    sign_1 = p1[..., 0] & 1
+    return (sign_0 | (zero_0 & sign_1)).astype(jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# SSWU + isogeny (batched over a flat axis)
+# ---------------------------------------------------------------------------
+
+
+def map_to_curve_g2(u):
+    """Batched simplified-SWU + 3-isogeny: Fp2 batch -> affine E' batch.
+
+    Mirrors oracle map_to_curve_g2; every branch is compute-both+select.
+    """
+    shape = u[0].shape[:-1]
+    zt2 = tw.f2_mul(_bc2(_SSWU_Z, shape), tw.f2_sqr(u))
+    tv1 = tw.f2_add(tw.f2_sqr(zt2), zt2)
+    tv1_zero = tw.f2_is_zero(tv1)
+    # safe inverse: where tv1 == 0 the select below discards the value
+    inv_tv1 = f2_inv_pow(tv1)
+    one_plus = tw.f2_add(tw.f2_one(shape=shape), inv_tv1)
+    x1_gen = tw.f2_mul(_bc2(_NEG_B_DIV_A, shape), one_plus)
+    x1 = tw.f2_select(tv1_zero, _bc2(_B_DIV_ZA, shape), x1_gen)
+
+    def g_of(x):
+        xx = tw.f2_add(tw.f2_sqr(x), _bc2(_SSWU_A, shape))
+        return tw.f2_add(tw.f2_mul(xx, x), _bc2(_SSWU_B, shape))
+
+    x2 = tw.f2_mul(zt2, x1)
+    gx1 = g_of(x1)
+    gx2 = g_of(x2)
+
+    # ONE stacked sqrt instance over [gx1; gx2]
+    g_both = jax.tree.map(lambda a, b: jnp.concatenate([a, b]), gx1, gx2)
+    y_both, ok_both = f2_sqrt_both(g_both)
+    n = shape[0]
+    y1 = jax.tree.map(lambda t: t[:n], y_both)
+    y2 = jax.tree.map(lambda t: t[n:], y_both)
+    ok1 = ok_both[:n]
+
+    x = tw.f2_select(ok1, x1, x2)
+    y = tw.f2_select(ok1, y1, y2)
+    # sign fix: sgn0(u) == sgn0(y)
+    flip = _f2_sgn0(u) != _f2_sgn0(y)
+    y = tw.f2_select(flip, tw.f2_neg(y), y)
+
+    # 3-isogeny, one stacked inversion for both denominators
+    xn = _horner(_XNUM, x, shape)
+    xd = _horner(_XDEN, x, shape)
+    yn = _horner(_YNUM, x, shape)
+    yd = _horner(_YDEN, x, shape)
+    d_both = jax.tree.map(lambda a, b: jnp.concatenate([a, b]), xd, yd)
+    i_both = f2_inv_pow(d_both)
+    xdi = jax.tree.map(lambda t: t[:n], i_both)
+    ydi = jax.tree.map(lambda t: t[n:], i_both)
+    xo = tw.f2_mul(xn, xdi)
+    yo = tw.f2_mul(tw.f2_mul(y, yn), ydi)
+    return (xo, yo)
+
+
+def _horner(coeffs, x, shape):
+    acc = _bc2(coeffs[-1], shape)
+    for c in reversed(coeffs[:-1]):
+        acc = tw.f2_add(tw.f2_mul(acc, x), _bc2(c, shape))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# psi endomorphism + cofactor clearing (Jacobian, batched)
+# ---------------------------------------------------------------------------
+
+
+def _psi(pt):
+    """(X, Y, Z) -> (cx*conj(X), cy*conj(Y), conj(Z)) — inversion-free
+    projective form of the oracle's affine psi."""
+    X, Y, Z = pt
+    shape = X[0].shape[:-1]
+    return (
+        tw.f2_mul(_bc2(_PSI_CX, shape), tw.f2_conj(X)),
+        tw.f2_mul(_bc2(_PSI_CY, shape), tw.f2_conj(Y)),
+        tw.f2_conj(Z),
+    )
+
+
+def _mul_abs_x(pt):
+    """[|x|]P via the shared scalar_mul_bits instance (static bits)."""
+    B = pt[0][0].shape[0]
+    bits = jnp.broadcast_to(jnp.asarray(_ABS_X_BITS), (B, 64))
+    return cv.scalar_mul_bits(cv.F2, pt, bits)
+
+
+def clear_cofactor(pt):
+    """Budroni-Pintore: [x^2-x-1]P + [x-1]psi(P) + psi^2([2]P), x < 0."""
+    F = cv.F2
+    t = _mul_abs_x(pt)
+    x_p = cv.jac_neg(F, t)          # [x]P
+    u = _mul_abs_x(x_p)
+    x2_p = cv.jac_neg(F, u)         # [x^2]P
+    part1 = cv.jac_add(F, cv.jac_add(F, x2_p, cv.jac_neg(F, x_p)),
+                       cv.jac_neg(F, pt))
+    # [x-1]psi(P) = -([|x|]psi(P) + psi(P))
+    psip = _psi(pt)
+    part2 = cv.jac_neg(F, cv.jac_add(F, _mul_abs_x(psip), psip))
+    part3 = _psi(_psi(cv.jac_double(F, pt)))
+    return cv.jac_add(F, cv.jac_add(F, part1, part2), part3)
+
+
+# ---------------------------------------------------------------------------
+# full hash_to_g2 from field draws
+# ---------------------------------------------------------------------------
+
+
+def hash_to_g2_from_fields(u0, u1):
+    """(B,)-batched field draws -> (B,) Jacobian G2 points in the subgroup.
+
+    u0/u1: Fp2 limb tuples in PLAIN (non-Montgomery) canonical form —
+    hash_to_field output encoded by ``encode_field_draws``; conversion to
+    Montgomery form is the kernel's first (batched) multiply, keeping the
+    host encode pure byte-shuffling.  The two SSWU+isogeny maps run
+    STACKED as one 2B-wide batch; cofactor clearing runs once on the
+    summed point.
+    """
+    u_both = jax.tree.map(lambda a, b: jnp.concatenate([a, b]), u0, u1)
+    u_both = (fp.to_mont(u_both[0]), fp.to_mont(u_both[1]))
+    aff = map_to_curve_g2(u_both)
+    n = u0[0].shape[0]
+    q0 = cv.from_affine(cv.F2, jax.tree.map(lambda t: t[:n], aff))
+    q1 = cv.from_affine(cv.F2, jax.tree.map(lambda t: t[n:], aff))
+    return clear_cofactor(cv.jac_add(cv.F2, q0, q1))
+
+
+# ---------------------------------------------------------------------------
+# host-side field-draw encoding (expand_message via native C SHA-256)
+# ---------------------------------------------------------------------------
+
+_LIMB_WEIGHTS = (1 << np.arange(13, dtype=np.uint32)).astype(np.uint32)
+
+
+def _ints_to_limbs_np(vals) -> np.ndarray:
+    """Vectorized python-int batch -> (N, 30) plain radix-2^13 limbs.
+
+    48-byte big-endian per value -> unpack to LSB-first bits -> regroup
+    into 13-bit limbs.  Pure numpy; ~1 us/value vs ~40 us for the
+    per-element int_to_limbs loop (the host side of the hashed verify
+    path must stay negligible next to the device kernel)."""
+    raw = np.frombuffer(
+        b"".join(v.to_bytes(48, "little") for v in vals), dtype=np.uint8
+    ).reshape(len(vals), 48)
+    bits = np.unpackbits(raw, axis=1, bitorder="little")  # (N, 384)
+    bits = np.pad(bits, ((0, 0), (0, 390 - 384)))
+    limbs = bits.reshape(len(vals), 30, 13).astype(np.uint32) @ _LIMB_WEIGHTS
+    return limbs.astype(np.uint32)
+
+
+def encode_field_draws(messages, size: int):
+    """Host: messages -> (u0, u1) PLAIN limb tensors, padded to ``size``
+    (padding lanes draw u = 0, masked out downstream)."""
+    from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_field_fp2
+
+    draws = [hash_to_field_fp2(m, 2) for m in messages]
+    while len(draws) < size:
+        draws.append([(0, 0), (0, 0)])
+    enc = lambda vals: jnp.asarray(_ints_to_limbs_np(vals))
+    u0 = (enc([d[0][0] for d in draws]), enc([d[0][1] for d in draws]))
+    u1 = (enc([d[1][0] for d in draws]), enc([d[1][1] for d in draws]))
+    return u0, u1

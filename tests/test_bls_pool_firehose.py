@@ -1,0 +1,72 @@
+"""Synthetic gossip firehose against the device verifier pool policy.
+
+VERDICT r3 weak #4: the pool must sustain node-shaped load with p99
+request latency under the 1 s gossip budget.  The device is simulated
+with the latency model measured on TPU v5e in round 4 (a ~350 ms
+sequential-scan floor plus a mild per-set term) so the POLICY — window
+flushes, job packing, queue behavior under load — is what's under test;
+the kernel itself is timed by bench.py on hardware.
+"""
+import asyncio
+import random
+import time
+
+from lodestar_tpu.chain.bls import DeviceBlsVerifier, VerifyOptions
+from lodestar_tpu.crypto.bls.api import PublicKey, Signature, SignatureSet
+
+
+class ModelledDevice:
+    """Latency-modelled fake device (r4 bench: 628 ms @1024, ~1 s @4096)."""
+
+    FLOOR_S = 0.35
+    PER_SET_S = 0.00017
+
+    def __init__(self):
+        self.jobs = []
+
+    def verify_signature_sets_device(self, sets):
+        # run_in_executor calls this in a worker thread: block like the
+        # real chip would
+        time.sleep(self.FLOOR_S + self.PER_SET_S * len(sets))
+        self.jobs.append(len(sets))
+        return True
+
+    def verify_each_device(self, sets):
+        time.sleep(self.FLOOR_S + self.PER_SET_S * len(sets))
+        return [True] * len(sets)
+
+
+def _dummy_set():
+    return SignatureSet(PublicKey((1, 2)), b"m" * 32, Signature(((1, 2), (3, 4))))
+
+
+def test_firehose_p99_under_one_second():
+    """Offered load ~2,500 sets/s for ~3 s of simulated gossip bursts."""
+    pool = DeviceBlsVerifier(_backend=ModelledDevice())
+    rng = random.Random(7)
+    latencies = []
+
+    async def one_request(n_sets):
+        t0 = time.monotonic()
+        ok = await pool.verify_signature_sets(
+            [_dummy_set()] * n_sets, VerifyOptions(batchable=True)
+        )
+        latencies.append(time.monotonic() - t0)
+        assert ok
+
+    async def go():
+        tasks = []
+        # ~100 bursts of 1-50 sets arriving over ~3 s => ~2,500 sets/s
+        for _ in range(100):
+            tasks.append(asyncio.ensure_future(one_request(rng.randint(1, 50))))
+            await asyncio.sleep(rng.uniform(0.01, 0.05) * 0.6)
+        await asyncio.gather(*tasks)
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())
+
+    latencies.sort()
+    p99 = latencies[int(0.99 * (len(latencies) - 1))]
+    assert p99 < 1.0, f"p99 {p99:.3f}s over the 1s gossip budget"
+    # the window must be packing requests into large jobs, not trickling
+    dev = pool._dv
+    assert max(dev.jobs) > 100, f"no large jobs formed: {dev.jobs}"

@@ -11,7 +11,6 @@ import pytest
 
 from lodestar_tpu.chain.bls import (
     DeviceBlsVerifier,
-    MAX_BUFFERED_SIGS,
     SingleThreadBlsVerifier,
     VerifyOptions,
 )
@@ -77,17 +76,31 @@ class TestDevicePool:
         # all 5 single-set requests coalesced (flush happened once, 5 sets)
         assert pool._dv.batch_calls == [5]
 
-    def test_window_flushes_at_max_buffered_sigs(self, pool):
+    def test_window_flushes_immediately_at_full_job(self):
+        """A full device job's worth of buffered sets must schedule an
+        immediate (delay 0) flush, not wait out the 100 ms window.
+        Asserted on the scheduled delays — deterministic on loaded CI."""
+
+        class InstantBackend(FakeBackend):
+            def verify_signature_sets_device(self, sets):
+                self.batch_calls.append(len(sets))
+                return True  # no oracle pairings needed here
+
+        pool = DeviceBlsVerifier(_backend=InstantBackend(), max_sets_per_job=8)
+        delays = []
+        orig = pool._schedule_flush
+        pool._schedule_flush = lambda d: (delays.append(d), orig(d))[1]
+
         async def go():
             opts = VerifyOptions(batchable=True)
-            n = MAX_BUFFERED_SIGS
             return await asyncio.gather(
-                *(pool.verify_signature_sets(make_sets(1), opts) for _ in range(n))
+                *(pool.verify_signature_sets(make_sets(1), opts) for _ in range(8))
             )
 
         res = run(go())
         assert all(res)
-        assert sum(pool._dv.batch_calls) == MAX_BUFFERED_SIGS
+        assert sum(pool._dv.batch_calls) == 8
+        assert 0 in delays, f"no immediate flush scheduled (delays: {delays})"
 
     def test_invalid_set_triggers_per_set_fallback(self, pool):
         async def go():
@@ -100,7 +113,9 @@ class TestDevicePool:
         assert res == [True, False]
         assert pool._dv.each_calls, "fallback per-set pass did not run"
 
-    def test_oversized_request_chunks(self, pool):
+    def test_oversized_request_chunks(self):
+        pool = DeviceBlsVerifier(_backend=FakeBackend(), max_sets_per_job=128)
+
         async def go():
             return await pool.verify_signature_sets(
                 make_sets(130), VerifyOptions(batchable=True)

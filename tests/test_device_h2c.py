@@ -1,0 +1,77 @@
+"""Device hash-to-curve (ops/bls12_381/h2c.py) vs the Python oracle.
+
+The oracle is pinned to the RFC 9380 vectors (test_bls_oracle.py), so
+bit-equality here transitively pins the device pipeline to the RFC.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from lodestar_tpu.crypto.bls import hash_to_curve as oh2c
+from lodestar_tpu.crypto.bls.curve import g2
+from lodestar_tpu.ops.bls12_381 import curve as cv, fp, h2c, tower as tw, verify as dv
+
+
+def _decode_f2(t):
+    return (fp.decode(np.asarray(t[0])), fp.decode(np.asarray(t[1])))
+
+
+def _encode_f2_batch(vals):
+    import jax.numpy as jnp
+
+    e = lambda xs: jnp.asarray(np.stack([fp.encode_int(v) for v in xs]))
+    return (e([v[0] for v in vals]), e([v[1] for v in vals]))
+
+
+def _jac_to_affine_int(jac):
+    """Decode one lane of a device Jacobian G2 batch to oracle affine."""
+    x = _decode_f2(jax.tree.map(lambda t: np.asarray(t), jac[0]))
+    y = _decode_f2(jac[1])
+    z = _decode_f2(jac[2])
+    return g2.to_affine((x, y, z))
+
+
+def test_map_to_curve_matches_oracle():
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    us = [u for m in msgs for u in oh2c.hash_to_field_fp2(m, 2)]
+    enc = _encode_f2_batch(us)
+    out = jax.jit(h2c.map_to_curve_g2)(enc)
+    for i, u in enumerate(us):
+        exp = oh2c.map_to_curve_g2(u)
+        got = (
+            _decode_f2(jax.tree.map(lambda t: t[i], out[0])),
+            _decode_f2(jax.tree.map(lambda t: t[i], out[1])),
+        )
+        assert got == exp, i
+
+
+def test_hash_to_g2_from_fields_matches_oracle():
+    msgs = [bytes([7 + i]) * 32 for i in range(4)]
+    u0, u1 = h2c.encode_field_draws(msgs, 4)
+    jac = jax.jit(h2c.hash_to_g2_from_fields)(u0, u1)
+    for i, m in enumerate(msgs):
+        lane = jax.tree.map(lambda t: np.asarray(t)[i], jac)
+        assert _jac_to_affine_int(lane) == g2.to_affine(oh2c.hash_to_g2(m)), i
+
+
+def test_verify_signature_sets_hashed():
+    from lodestar_tpu.crypto.bls import api
+    from lodestar_tpu.ops.bls12_381 import verify as dvv
+
+    B = 4
+    sets = []
+    for i in range(B):
+        sk = api.SecretKey.from_bytes((i + 11).to_bytes(32, "big"))
+        msg = bytes([i]) * 32
+        sets.append(api.SignatureSet(sk.to_public_key(), msg, sk.sign(msg)))
+    pk_aff, pk_inf, sig_aff, sig_inf, active = dvv._encode_pk_sig(sets, B)
+    u0, u1 = h2c.encode_field_draws([s.message for s in sets], B)
+    rand = [(2 * i + 3) | 1 for i in range(B)]
+    bits = cv.scalars_to_bits(rand, 64)
+    fn = jax.jit(dvv.verify_signature_sets_hashed)
+    assert bool(fn(pk_aff, pk_inf, u0, u1, sig_aff, sig_inf, bits, active))
+    import jax.numpy as jnp
+
+    bad_sig = jax.tree.map(lambda t: jnp.roll(t, 1, axis=0), sig_aff)
+    assert not bool(fn(pk_aff, pk_inf, u0, u1, bad_sig, sig_inf, bits, active))
