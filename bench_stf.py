@@ -1,0 +1,287 @@
+"""State-transition performance harness — block + epoch processing at
+mainnet scale, against the reference's perf ceilings.
+
+Reference role: packages/state-transition/test/perf/{block,epoch,slot}
+with .benchrc thresholds; the operational ceilings recorded in
+stateCache.ts:36-37 are 500 ms for block processing and 4 s for epoch
+processing.  This harness fabricates a mainnet-preset altair state with
+N validators (default 250,000 — the reference perf suite's shape) the
+same way generatePerfTestCachedStateAltair does: directly, no deposits
+or signatures, then measures:
+
+  * process_block: a full block carrying MAX_ATTESTATIONS (128)
+    all-bits-set attestations + sync aggregate, signatures off (the
+    signature sets are verified by the BLS pool separately — bench.py)
+  * process_epoch: full altair epoch processing + cache rotation
+  * hash_tree_root of the full state (merkleization via native C SHA)
+
+Prints one JSON line per metric (driver-style) and a final summary line.
+Run: LODESTAR_TPU_PRESET=mainnet python bench_stf.py [n_validators]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("LODESTAR_TPU_PRESET", "mainnet")
+
+N_DEFAULT = 250_000
+
+BLOCK_CEILING_S = 0.500
+EPOCH_CEILING_S = 4.0
+
+
+def build_state(n: int):
+    from lodestar_tpu.params import ACTIVE_PRESET as P, FAR_FUTURE_EPOCH
+    from lodestar_tpu.types import ssz
+
+    epoch = 10
+    slot = epoch * P.SLOTS_PER_EPOCH + P.SLOTS_PER_EPOCH // 2
+    root = b"\x11" * 32
+
+    validators = []
+    for i in range(n):
+        validators.append(
+            ssz.phase0.Validator(
+                pubkey=i.to_bytes(48, "little"),
+                withdrawal_credentials=b"\x00" * 32,
+                effective_balance=P.MAX_EFFECTIVE_BALANCE,
+                slashed=False,
+                activation_eligibility_epoch=0,
+                activation_epoch=0,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+        )
+    sync_pubkeys = [
+        (i % n).to_bytes(48, "little") for i in range(P.SYNC_COMMITTEE_SIZE)
+    ]
+    sync_committee = ssz.altair.SyncCommittee(
+        pubkeys=sync_pubkeys, aggregate_pubkey=b"\x01" * 48
+    )
+    state = ssz.altair.BeaconState(
+        genesis_time=0,
+        genesis_validators_root=root,
+        slot=slot,
+        fork=ssz.phase0.Fork(
+            previous_version=b"\x01\x00\x00\x00",
+            current_version=b"\x01\x00\x00\x00",
+            epoch=0,
+        ),
+        latest_block_header=ssz.phase0.BeaconBlockHeader(
+            slot=slot - 1,
+            proposer_index=0,
+            parent_root=root,
+            state_root=b"\x00" * 32,
+            body_root=root,
+        ),
+        block_roots=[root] * P.SLOTS_PER_HISTORICAL_ROOT,
+        state_roots=[root] * P.SLOTS_PER_HISTORICAL_ROOT,
+        historical_roots=[],
+        eth1_data=ssz.phase0.Eth1Data(
+            deposit_root=root, deposit_count=n, block_hash=root
+        ),
+        eth1_data_votes=[],
+        eth1_deposit_index=n,
+        validators=validators,
+        balances=[P.MAX_EFFECTIVE_BALANCE] * n,
+        randao_mixes=[bytes([i % 256]) * 32 for i in range(P.EPOCHS_PER_HISTORICAL_VECTOR)],
+        slashings=[0] * P.EPOCHS_PER_SLASHINGS_VECTOR,
+        previous_epoch_participation=[0b111] * n,
+        current_epoch_participation=[0b111] * n,
+        justification_bits=[True, True, True, True],
+        previous_justified_checkpoint=ssz.phase0.Checkpoint(
+            epoch=epoch - 2, root=root
+        ),
+        current_justified_checkpoint=ssz.phase0.Checkpoint(
+            epoch=epoch - 1, root=root
+        ),
+        finalized_checkpoint=ssz.phase0.Checkpoint(epoch=epoch - 2, root=root),
+        inactivity_scores=[0] * n,
+        current_sync_committee=sync_committee,
+        next_sync_committee=sync_committee,
+    )
+    return state
+
+
+def build_block(cached):
+    """A full block: MAX_ATTESTATIONS committee-correct attestations with
+    every aggregation bit set, plus an all-set sync aggregate."""
+    from lodestar_tpu.params import ACTIVE_PRESET as P
+    from lodestar_tpu.types import ssz
+
+    state = cached.state
+    ctx = cached.epoch_ctx
+    slot = int(state.slot)
+    epoch = slot // P.SLOTS_PER_EPOCH
+    root = b"\x11" * 32
+
+    atts = []
+    att_slot = slot - 1  # inclusion delay 1
+    while len(atts) < P.MAX_ATTESTATIONS and att_slot >= epoch * P.SLOTS_PER_EPOCH:
+        count = ctx.get_committee_count_per_slot(epoch)
+        for idx in range(count):
+            if len(atts) >= P.MAX_ATTESTATIONS:
+                break
+            committee = ctx.get_committee(att_slot, idx)
+            atts.append(
+                ssz.phase0.Attestation(
+                    aggregation_bits=[True] * len(committee),
+                    data=ssz.phase0.AttestationData(
+                        slot=att_slot,
+                        index=idx,
+                        beacon_block_root=root,
+                        source=ssz.phase0.Checkpoint(
+                            epoch=epoch - 1, root=root
+                        ),
+                        target=ssz.phase0.Checkpoint(epoch=epoch, root=root),
+                    ),
+                )
+            )
+        att_slot -= 1
+
+    body = ssz.altair.BeaconBlockBody(
+        randao_reveal=b"\x00" * 96,
+        eth1_data=state.eth1_data,
+        graffiti=b"\x00" * 32,
+        proposer_slashings=[],
+        attester_slashings=[],
+        attestations=atts,
+        deposits=[],
+        voluntary_exits=[],
+        sync_aggregate=ssz.altair.SyncAggregate(
+            sync_committee_bits=[True] * P.SYNC_COMMITTEE_SIZE,
+            sync_committee_signature=b"\x00" * 96,
+        ),
+    )
+    parent_root = ssz.phase0.BeaconBlockHeader.hash_tree_root(
+        state.latest_block_header
+    )
+    return ssz.altair.BeaconBlock(
+        slot=slot,
+        proposer_index=ctx.get_beacon_proposer(slot),
+        parent_root=parent_root,
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else N_DEFAULT
+    from lodestar_tpu.config import default_chain_config
+    from lodestar_tpu.state_transition.state_transition import (
+        CachedBeaconState,
+        processors_for,
+        state_hash_tree_root,
+    )
+
+    cfg = default_chain_config
+
+    t0 = time.perf_counter()
+    state = build_state(n)
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    cached = CachedBeaconState(cfg, state)
+    ctx_s = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": "stf_setup",
+                "validators": n,
+                "build_state_s": round(build_s, 2),
+                "epoch_ctx_s": round(ctx_s, 2),
+            }
+        ),
+        flush=True,
+    )
+
+    results = {}
+
+    # --- block processing ------------------------------------------------
+    block_mod, epoch_mod = processors_for(state)
+    block = build_block(cached)
+    times = []
+    for _ in range(3):
+        work = cached.clone()
+        t0 = time.perf_counter()
+        block_mod.process_block(
+            cfg, work.state, work.epoch_ctx, block, False
+        )
+        times.append(time.perf_counter() - t0)
+    block_s = min(times)
+    results["block"] = block_s
+    print(
+        json.dumps(
+            {
+                "metric": "stf_process_block_ms",
+                "value": round(block_s * 1e3, 1),
+                "unit": "ms",
+                "vs_baseline": round(BLOCK_CEILING_S / block_s, 2),
+                "ceiling_ms": BLOCK_CEILING_S * 1e3,
+                "attestations": len(block.body.attestations),
+            }
+        ),
+        flush=True,
+    )
+
+    # --- epoch processing ------------------------------------------------
+    from lodestar_tpu.params import ACTIVE_PRESET as P
+
+    times = []
+    for _ in range(2):
+        work = cached.clone()
+        work.state.slot = (int(work.state.slot) // P.SLOTS_PER_EPOCH + 1) * P.SLOTS_PER_EPOCH - 1
+        t0 = time.perf_counter()
+        epoch_mod.process_epoch(cfg, work.state, work.epoch_ctx)
+        work.state.slot += 1
+        work.epoch_ctx.rotate(work.state)
+        times.append(time.perf_counter() - t0)
+    epoch_s = min(times)
+    results["epoch"] = epoch_s
+    print(
+        json.dumps(
+            {
+                "metric": "stf_process_epoch_ms",
+                "value": round(epoch_s * 1e3, 1),
+                "unit": "ms",
+                "vs_baseline": round(EPOCH_CEILING_S / epoch_s, 2),
+                "ceiling_ms": EPOCH_CEILING_S * 1e3,
+            }
+        ),
+        flush=True,
+    )
+
+    # --- state merkleization ---------------------------------------------
+    t0 = time.perf_counter()
+    state_hash_tree_root(cached.state)
+    htr_s = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": "stf_state_hash_tree_root_ms",
+                "value": round(htr_s * 1e3, 1),
+                "unit": "ms",
+            }
+        ),
+        flush=True,
+    )
+
+    ok = block_s <= BLOCK_CEILING_S and epoch_s <= EPOCH_CEILING_S
+    print(
+        json.dumps(
+            {
+                "metric": "stf_within_reference_ceilings",
+                "value": bool(ok),
+                "block_ms": round(block_s * 1e3, 1),
+                "epoch_ms": round(epoch_s * 1e3, 1),
+                "validators": n,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -129,10 +129,17 @@ def build_parser() -> argparse.ArgumentParser:
     flare.add_argument("--index", type=int, required=True, help="interop validator index")
     flare.add_argument("--epoch", type=int, default=0)
 
-    # --param KEY=VALUE chain-config overrides on every subcommand
-    # (the reference's `--params.ALTAIR_FORK_EPOCH=0` yargs flags +
-    # config/chainConfig YAML loading, cli/src/options/paramsOptions.ts)
+    # --network / --param on every subcommand (the reference's
+    # `--network sepolia` + `--params.ALTAIR_FORK_EPOCH=0` yargs flags,
+    # cli/src/options/{globalOptions,paramsOptions}.ts + cli/src/networks/)
     for p in sub.choices.values():
+        p.add_argument(
+            "--network",
+            type=str,
+            default=None,
+            help="named network bundle (mainnet, sepolia, goerli): chain "
+                 "config + genesis anchors from lodestar_tpu.networks",
+        )
         p.add_argument(
             "--param",
             action="append",
@@ -144,9 +151,23 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def resolve_chain_config(args):
-    """default_chain_config + any --param overrides."""
+    """--network bundle (if any) + any --param overrides."""
     from lodestar_tpu.config import chain_config_from_dict, default_chain_config
 
+    base = default_chain_config
+    network = getattr(args, "network", None)
+    if network:
+        from lodestar_tpu.params import ACTIVE_PRESET_NAME
+        from lodestar_tpu.networks import get_network
+
+        bundle = get_network(network)
+        if bundle.chain_config.PRESET_BASE != ACTIVE_PRESET_NAME:
+            raise SystemExit(
+                f"--network {network} needs the "
+                f"{bundle.chain_config.PRESET_BASE} preset "
+                f"(set LODESTAR_TPU_PRESET={bundle.chain_config.PRESET_BASE})"
+            )
+        base = bundle.chain_config
     overrides = {}
     for kv in getattr(args, "param", []) or []:
         if "=" not in kv:
@@ -154,14 +175,14 @@ def resolve_chain_config(args):
         k, v = kv.split("=", 1)
         overrides[k] = v
     if not overrides:
-        return default_chain_config
+        return base
     import dataclasses
 
-    known = {f.name for f in dataclasses.fields(type(default_chain_config))}
+    known = {f.name for f in dataclasses.fields(type(base))}
     unknown = set(overrides) - known
     if unknown:
         raise SystemExit(f"unknown --param key(s): {', '.join(sorted(unknown))}")
-    return chain_config_from_dict(overrides)
+    return chain_config_from_dict(overrides, base=base)
 
 
 def resolve_verifier_choice(choice: str) -> str:
@@ -266,11 +287,52 @@ def run_beacon(args) -> int:
     from lodestar_tpu.metrics.server import HttpMetricsServer
     from lodestar_tpu.state_transition.util.genesis import init_dev_state
 
+    # named networks supply genesis anchors + default checkpoint
+    # providers (cli/src/networks role)
+    bundle = None
+    if getattr(args, "network", None):
+        from lodestar_tpu.networks import get_network
+
+        bundle = get_network(args.network)
+        if not getattr(args, "checkpoint_sync_url", None) and not args.checkpoint_state:
+            if bundle.checkpoint_sync_urls:
+                print(
+                    f"note: --network {bundle.name} nodes normally start from "
+                    f"a checkpoint provider, e.g. {bundle.checkpoint_sync_urls[0]} "
+                    "(pass --checkpoint-sync-url); falling back to a dev genesis",
+                    flush=True,
+                )
+
+    def _check_bundle_anchor(anchor_state) -> None:
+        """A checkpoint state for --network X must belong to network X
+        (wrong-network anchors silently build an unusable node)."""
+        if bundle is None:
+            return
+        gvr = bytes(anchor_state.genesis_validators_root)
+        # deployed-network anchors must match the recorded root; dev/test
+        # fixtures (self-genesis'd states) are identified by config match
+        if gvr != bundle.genesis_validators_root and (
+            bytes(anchor_state.fork.current_version)[:4]
+            not in (
+                bundle.chain_config.GENESIS_FORK_VERSION,
+                bundle.chain_config.ALTAIR_FORK_VERSION,
+                bundle.chain_config.BELLATRIX_FORK_VERSION,
+                bundle.chain_config.CAPELLA_FORK_VERSION,
+            )
+        ):
+            raise SystemExit(
+                f"checkpoint state is not a {bundle.name} state "
+                f"(genesis_validators_root {gvr.hex()} and fork version "
+                f"{bytes(anchor_state.fork.current_version).hex()} match "
+                "neither the network's root nor its fork schedule)"
+            )
+
     if args.checkpoint_state:
         # weak-subjectivity start (initBeaconState.ts checkpoint sync)
         from lodestar_tpu.db.beacon import _STATE_MF
 
         anchor = _STATE_MF.deserialize(open(args.checkpoint_state, "rb").read())
+        _check_bundle_anchor(anchor)
         print(f"checkpoint sync: anchor slot {anchor.slot}", flush=True)
     elif getattr(args, "checkpoint_sync_url", None):
         # fetch the trusted node's finalized state over REST
@@ -285,6 +347,7 @@ def run_beacon(args) -> int:
                 await client.close()
 
         anchor = asyncio.run(_fetch())
+        _check_bundle_anchor(anchor)
         print(
             f"checkpoint sync from {args.checkpoint_sync_url}: "
             f"anchor slot {anchor.slot}",

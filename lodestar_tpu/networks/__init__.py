@@ -1,0 +1,127 @@
+"""Named network bundles — config + genesis anchors as data modules.
+
+Reference role: packages/cli/src/networks/{mainnet,sepolia,goerli}.ts,
+which bundle each network's chain config, genesis metadata and bootnode
+lists behind the `--network` flag.  Here each bundle carries:
+
+  * chain_config      — the network's ChainConfig (fork schedule, TTD,
+                        deposit contract), values from the public
+                        consensus-specs config files
+  * genesis_validators_root / genesis_time — the deployed chain's
+                        anchors (needed to compute fork digests and to
+                        validate checkpoint states without genesis)
+  * checkpoint_sync_urls — public weak-subjectivity providers
+  * bootnodes         — wire-format ENRs for this client (hex SSZ,
+                        network/discovery.py records).  DOCUMENTED
+                        DEVIATION: the rebuild's discovery speaks its
+                        own signed-record format, not discv5-wire, so
+                        the canonical EF bootnode `enr:` strings (shipped
+                        in the reference's networks/*.ts) cannot be
+                        dialed and are not embedded; operators seed
+                        peers via --bootnode-enr or these lists once
+                        records exist for a deployment.
+
+NOTE: sepolia/goerli/mainnet run the mainnet *preset*; select it with
+LODESTAR_TPU_PRESET=mainnet (the CLI enforces this at resolution).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+from lodestar_tpu.config import ChainConfig, FAR_FUTURE_EPOCH
+
+
+@dataclass(frozen=True)
+class NetworkBundle:
+    name: str
+    chain_config: ChainConfig
+    genesis_validators_root: bytes
+    genesis_time: int
+    checkpoint_sync_urls: tuple = ()
+    bootnodes: tuple = ()  # wire-format ENR hex strings (this client)
+
+
+mainnet = NetworkBundle(
+    name="mainnet",
+    chain_config=ChainConfig(),  # defaults ARE mainnet
+    genesis_validators_root=bytes.fromhex(
+        "4b363db94e286120d76eb905340fdd4e54bfe9f06bf33ff6cf5ad27f511bfe95"
+    ),
+    genesis_time=1606824023,
+    checkpoint_sync_urls=(
+        "https://beaconstate.info",
+        "https://mainnet-checkpoint-sync.attestant.io",
+    ),
+)
+
+sepolia = NetworkBundle(
+    name="sepolia",
+    chain_config=ChainConfig(
+        PRESET_BASE="mainnet",
+        CONFIG_NAME="sepolia",
+        TERMINAL_TOTAL_DIFFICULTY=17_000_000_000_000_000,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=1300,
+        MIN_GENESIS_TIME=1655647200,
+        GENESIS_FORK_VERSION=bytes.fromhex("90000069"),
+        GENESIS_DELAY=86400,
+        ALTAIR_FORK_VERSION=bytes.fromhex("90000070"),
+        ALTAIR_FORK_EPOCH=50,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("90000071"),
+        BELLATRIX_FORK_EPOCH=100,
+        CAPELLA_FORK_VERSION=bytes.fromhex("90000072"),
+        CAPELLA_FORK_EPOCH=FAR_FUTURE_EPOCH,
+        DEPOSIT_CHAIN_ID=11155111,
+        DEPOSIT_NETWORK_ID=11155111,
+        DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex(
+            "7f02c3e3c98b133055b8b348b2ac625669ed295d"
+        ),
+    ),
+    genesis_validators_root=bytes.fromhex(
+        "d8ea171f3c94aea21ebc42a1ed61052acf3f9209c00e4efbaaddac09ed9b8078"
+    ),
+    genesis_time=1655733600,
+    checkpoint_sync_urls=("https://sepolia.beaconstate.info",),
+)
+
+goerli = NetworkBundle(
+    name="goerli",
+    chain_config=ChainConfig(
+        PRESET_BASE="mainnet",
+        CONFIG_NAME="goerli",
+        TERMINAL_TOTAL_DIFFICULTY=10_790_000,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16384,
+        MIN_GENESIS_TIME=1614588812,
+        GENESIS_FORK_VERSION=bytes.fromhex("00001020"),
+        GENESIS_DELAY=1919188,
+        ALTAIR_FORK_VERSION=bytes.fromhex("01001020"),
+        ALTAIR_FORK_EPOCH=36660,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("02001020"),
+        BELLATRIX_FORK_EPOCH=112260,
+        CAPELLA_FORK_VERSION=bytes.fromhex("03001020"),
+        CAPELLA_FORK_EPOCH=162304,
+        DEPOSIT_CHAIN_ID=5,
+        DEPOSIT_NETWORK_ID=5,
+        DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex(
+            "ff50ed3d0ec03ac01d4c79aad74928bff48a7b2b"
+        ),
+    ),
+    genesis_validators_root=bytes.fromhex(
+        "043db0d9a83813551ee2f33450d23797757d430911a9320530ad8a0eabc43efb"
+    ),
+    genesis_time=1616508000,
+    checkpoint_sync_urls=("https://goerli.beaconstate.info",),
+)
+
+NETWORKS: Dict[str, NetworkBundle] = {
+    b.name: b for b in (mainnet, sepolia, goerli)
+}
+
+
+def get_network(name: str) -> NetworkBundle:
+    try:
+        return NETWORKS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown network {name!r} (have: {', '.join(sorted(NETWORKS))})"
+        ) from None

@@ -58,11 +58,24 @@ _BODY_TYPES = {
 }
 
 
+# era-schema variants: fixture/devnet-era containers (e.g. pre-
+# historical_summaries capella) registered so the fork dispatch treats
+# them as their fork for processing purposes
+_STATE_VARIANTS: dict = {}
+
+
+def register_state_variant(fork: ForkName, state_type) -> None:
+    _STATE_VARIANTS.setdefault(fork, []).append(state_type)
+
+
 def fork_of_state(state) -> ForkName:
     """Which fork a BeaconState instance belongs to (by container type —
     the reference dispatches on allForks types the same way)."""
     for fork, t in _STATE_TYPES.items():
         if isinstance(state, t):
+            return fork
+    for fork, variants in _STATE_VARIANTS.items():
+        if any(isinstance(state, t) for t in variants):
             return fork
     raise TypeError(f"unknown state type {type(state)!r}")
 

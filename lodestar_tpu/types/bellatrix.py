@@ -8,6 +8,7 @@ from lodestar_tpu.params import ACTIVE_PRESET as _p, JUSTIFICATION_BITS_LENGTH
 from lodestar_tpu.ssz.core import (
     Bitvector,
     ByteList,
+    ByteVector,
     Bytes20,
     Bytes32,
     Container,
@@ -29,7 +30,7 @@ class ExecutionPayload(Container):
     fee_recipient: ExecutionAddress
     state_root: Bytes32
     receipts_root: Bytes32
-    logs_bloom: ByteList[_p.BYTES_PER_LOGS_BLOOM]
+    logs_bloom: ByteVector[_p.BYTES_PER_LOGS_BLOOM]
     prev_randao: Bytes32
     block_number: uint64
     gas_limit: uint64
@@ -46,7 +47,7 @@ class ExecutionPayloadHeader(Container):
     fee_recipient: ExecutionAddress
     state_root: Bytes32
     receipts_root: Bytes32
-    logs_bloom: ByteList[_p.BYTES_PER_LOGS_BLOOM]
+    logs_bloom: ByteVector[_p.BYTES_PER_LOGS_BLOOM]
     prev_randao: Bytes32
     block_number: uint64
     gas_limit: uint64

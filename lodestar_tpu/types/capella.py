@@ -9,6 +9,7 @@ from lodestar_tpu.params import ACTIVE_PRESET as _p, JUSTIFICATION_BITS_LENGTH
 from lodestar_tpu.ssz.core import (
     Bitvector,
     ByteList,
+    ByteVector,
     Bytes32,
     Container,
     List,
@@ -52,7 +53,7 @@ class ExecutionPayload(Container):
     fee_recipient: bellatrix.ExecutionAddress
     state_root: Bytes32
     receipts_root: Bytes32
-    logs_bloom: ByteList[_p.BYTES_PER_LOGS_BLOOM]
+    logs_bloom: ByteVector[_p.BYTES_PER_LOGS_BLOOM]
     prev_randao: Bytes32
     block_number: uint64
     gas_limit: uint64
@@ -70,7 +71,7 @@ class ExecutionPayloadHeader(Container):
     fee_recipient: bellatrix.ExecutionAddress
     state_root: Bytes32
     receipts_root: Bytes32
-    logs_bloom: ByteList[_p.BYTES_PER_LOGS_BLOOM]
+    logs_bloom: ByteVector[_p.BYTES_PER_LOGS_BLOOM]
     prev_randao: Bytes32
     block_number: uint64
     gas_limit: uint64

@@ -38,7 +38,7 @@ class ExecutionPayload(Container):
     fee_recipient: bellatrix.ExecutionAddress
     state_root: Bytes32
     receipts_root: Bytes32
-    logs_bloom: ByteList[_p.BYTES_PER_LOGS_BLOOM]
+    logs_bloom: ByteVector[_p.BYTES_PER_LOGS_BLOOM]
     prev_randao: Bytes32
     block_number: uint64
     gas_limit: uint64
@@ -57,7 +57,7 @@ class ExecutionPayloadHeader(Container):
     fee_recipient: bellatrix.ExecutionAddress
     state_root: Bytes32
     receipts_root: Bytes32
-    logs_bloom: ByteList[_p.BYTES_PER_LOGS_BLOOM]
+    logs_bloom: ByteVector[_p.BYTES_PER_LOGS_BLOOM]
     prev_randao: Bytes32
     block_number: uint64
     gas_limit: uint64

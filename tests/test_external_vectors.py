@@ -1,0 +1,32 @@
+"""External conformance vectors (real-devnet artifacts, not produced by
+this codebase — tests/fixtures/external/PROVENANCE.md).
+
+The suite runs under the minimal preset; the vectors are mainnet-preset,
+so the runner executes in a child process with the right env (same
+pattern as the driver's bench/dryrun children).  r4 result: the capella
+vector immediately caught a real SSZ deviation (logs_bloom encoded as
+ByteList instead of the spec's fixed ByteVector[256]).
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_external_vectors_pass():
+    env = dict(os.environ)
+    env["LODESTAR_TPU_PRESET"] = "mainnet"
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LODESTAR_TPU_FP_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_external_vectors.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "external vectors: ALL OK" in proc.stdout

@@ -1,0 +1,96 @@
+"""Named network bundles (--network): resolution + checkpoint-sync boot.
+
+Reference role: cli/src/networks/{mainnet,sepolia,goerli}.ts behind the
+--network flag.  The checkpoint fixture is a recorded fork-tagged SSZ
+state (tests/fixtures/sepolia_checkpoint_state.ssz, generated once by
+tools/gen_sepolia_fixture.py with the sepolia config on the mainnet
+preset).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from lodestar_tpu.networks import NETWORKS, get_network
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "sepolia_checkpoint_state.ssz")
+
+
+def test_bundles_resolve():
+    assert set(NETWORKS) == {"mainnet", "sepolia", "goerli"}
+    sep = get_network("sepolia")
+    assert sep.chain_config.GENESIS_FORK_VERSION == bytes.fromhex("90000069")
+    assert sep.chain_config.ALTAIR_FORK_EPOCH == 50
+    assert sep.chain_config.DEPOSIT_CHAIN_ID == 11155111
+    assert len(sep.genesis_validators_root) == 32
+    main = get_network("mainnet")
+    assert main.chain_config.CONFIG_NAME == "mainnet"
+    with pytest.raises(ValueError):
+        get_network("ropsten")
+
+
+def test_network_requires_matching_preset():
+    """sepolia runs the mainnet preset; under the test env's minimal
+    preset the CLI must refuse instead of mis-decoding states."""
+    from lodestar_tpu.cli.main import build_parser, resolve_chain_config
+
+    args = build_parser().parse_args(["beacon", "--network", "sepolia"])
+    with pytest.raises(SystemExit):
+        resolve_chain_config(args)
+
+
+def test_sepolia_checkpoint_sync_boot():
+    """`--network sepolia --checkpoint-state <recorded fixture>` must
+    anchor the node on the checkpoint state and boot (the
+    fetchWeakSubjectivityState/initBeaconState role)."""
+    env = dict(os.environ)
+    env["LODESTAR_TPU_PRESET"] = "mainnet"
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LODESTAR_TPU_FP_PLATFORM"] = "cpu"
+    import queue
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lodestar_tpu.cli.main", "beacon",
+         "--network", "sepolia", "--checkpoint-state", FIXTURE,
+         "--rest-port", "19616", "--metrics-port", "18016",
+         "--verifier", "oracle", "--slots", "1"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    q: "queue.Queue[str]" = queue.Queue()
+
+    def reader():
+        for line in proc.stdout:
+            q.put(line.strip())
+
+    threading.Thread(target=reader, daemon=True).start()
+    try:
+        lines = []
+        deadline = time.time() + 120
+        anchored = booted = False
+        while time.time() < deadline and not booted:
+            try:
+                line = q.get(timeout=1.0)  # never blocks past the deadline
+            except queue.Empty:
+                if proc.poll() is not None and q.empty():
+                    break
+                continue
+            lines.append(line)
+            if "checkpoint sync: anchor slot" in line:
+                anchored = True
+            if line.startswith("{") and '"head"' in line:
+                booted = True
+        assert anchored, f"no checkpoint anchor: {lines[-8:]}"
+        assert booted, f"node did not boot to a head: {lines[-8:]}"
+    finally:
+        proc.kill()
+        proc.wait()

@@ -1,0 +1,30 @@
+"""Generate tests/fixtures/sepolia_checkpoint_state.ssz — a recorded
+fork-tagged SSZ BeaconState fixture with the sepolia network config
+(mainnet preset, 16 interop validators) for the checkpoint-sync test.
+
+Run: LODESTAR_TPU_PRESET=mainnet python tools/gen_sepolia_fixture.py
+"""
+import os
+import sys
+
+os.environ["LODESTAR_TPU_PRESET"] = "mainnet"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lodestar_tpu.db.beacon import _STATE_MF  # noqa: E402
+from lodestar_tpu.networks import sepolia  # noqa: E402
+from lodestar_tpu.state_transition.util.genesis import init_dev_state  # noqa: E402
+
+# fixture genesis time is FIXED (recorded artifact, not wall clock); the
+# consuming test overrides nothing — the beacon boots, reports the
+# anchor, ticks one (very large) clock slot and exits
+_, state = init_dev_state(
+    sepolia.chain_config, 16, genesis_time=1_700_000_000
+)
+out = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", "sepolia_checkpoint_state.ssz",
+)
+os.makedirs(os.path.dirname(out), exist_ok=True)
+with open(out, "wb") as f:
+    f.write(_STATE_MF.serialize(state))
+print(f"wrote {out} ({os.path.getsize(out)} bytes)")
