@@ -160,7 +160,10 @@ def resolve_chain_config(args):
         from lodestar_tpu.params import ACTIVE_PRESET_NAME
         from lodestar_tpu.networks import get_network
 
-        bundle = get_network(network)
+        try:
+            bundle = get_network(network)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
         if bundle.chain_config.PRESET_BASE != ACTIVE_PRESET_NAME:
             raise SystemExit(
                 f"--network {network} needs the "

@@ -44,7 +44,10 @@ class NetworkBundle:
 
 mainnet = NetworkBundle(
     name="mainnet",
-    chain_config=ChainConfig(),  # defaults ARE mainnet
+    # defaults are the mainnet config; the deployed chain has since
+    # activated capella (Shapella, epoch 194048) — the bundle tracks the
+    # REAL network where the pinned reference default predates it
+    chain_config=ChainConfig(CAPELLA_FORK_EPOCH=194048),
     genesis_validators_root=bytes.fromhex(
         "4b363db94e286120d76eb905340fdd4e54bfe9f06bf33ff6cf5ad27f511bfe95"
     ),
@@ -70,7 +73,7 @@ sepolia = NetworkBundle(
         BELLATRIX_FORK_VERSION=bytes.fromhex("90000071"),
         BELLATRIX_FORK_EPOCH=100,
         CAPELLA_FORK_VERSION=bytes.fromhex("90000072"),
-        CAPELLA_FORK_EPOCH=FAR_FUTURE_EPOCH,
+        CAPELLA_FORK_EPOCH=56832,
         DEPOSIT_CHAIN_ID=11155111,
         DEPOSIT_NETWORK_ID=11155111,
         DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex(

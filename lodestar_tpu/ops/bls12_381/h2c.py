@@ -19,9 +19,10 @@ Design notes for the TPU shape of each stage:
   * Inversions use Fermat pows (batched, 96 scan steps) rather than the
     Montgomery prefix trick (2B sequential scan steps): on TPU the wide
     parallel pow beats the long sequential scan for any real batch.
-  * Cofactor clearing's two [|x|]-multiplications reuse the SAME
-    ``scalar_mul_bits`` instance (and its trace/compile cache entry) as
-    batch verification's r_i*sig_i multiplication.
+  * Cofactor clearing needs three [|x|]-multiplications; the two
+    independent ones ([|x|]P, [|x|]psi(P)) run stacked as ONE 2B-wide
+    ``scalar_mul_bits`` scan, the dependent [|x|][x]P as a second at
+    width B (shape-shared with batch verification's r_i*sig_i scan).
 
 Differential-tested against the Python oracle in
 tests/test_device_h2c.py (the oracle itself is pinned to the RFC 9380
@@ -240,17 +241,25 @@ def _mul_abs_x(pt):
 
 
 def clear_cofactor(pt):
-    """Budroni-Pintore: [x^2-x-1]P + [x-1]psi(P) + psi^2([2]P), x < 0."""
+    """Budroni-Pintore: [x^2-x-1]P + [x-1]psi(P) + psi^2([2]P), x < 0.
+
+    Two of the three [|x|]-multiplications ([|x|]P and [|x|]psi(P)) are
+    independent, so they run STACKED as one 2B-wide scan; only [|x|][x]P
+    is sequential.  Two scalar-mul scans total instead of three."""
     F = cv.F2
-    t = _mul_abs_x(pt)
+    psip = _psi(pt)
+    n = pt[0][0].shape[0]
+    both = jax.tree.map(lambda a, b: jnp.concatenate([a, b]), pt, psip)
+    m = _mul_abs_x(both)
+    t = jax.tree.map(lambda v: v[:n], m)        # [|x|]P
+    xpsi_abs = jax.tree.map(lambda v: v[n:], m)  # [|x|]psi(P)
     x_p = cv.jac_neg(F, t)          # [x]P
     u = _mul_abs_x(x_p)
     x2_p = cv.jac_neg(F, u)         # [x^2]P
     part1 = cv.jac_add(F, cv.jac_add(F, x2_p, cv.jac_neg(F, x_p)),
                        cv.jac_neg(F, pt))
     # [x-1]psi(P) = -([|x|]psi(P) + psi(P))
-    psip = _psi(pt)
-    part2 = cv.jac_neg(F, cv.jac_add(F, _mul_abs_x(psip), psip))
+    part2 = cv.jac_neg(F, cv.jac_add(F, xpsi_abs, psip))
     part3 = _psi(_psi(cv.jac_double(F, pt)))
     return cv.jac_add(F, cv.jac_add(F, part1, part2), part3)
 

