@@ -534,7 +534,39 @@ class ContainerMeta(type):
         return cls(**kwargs)
 
     def hash_tree_root(cls, value) -> bytes:
-        return merkleize_chunks(cls.field_roots(value))
+        # Value-keyed root memoization for SMALL FIXED containers
+        # (Validator, Checkpoint, ...): a root is a pure function of the
+        # value bytes, and big states hash the same 250k mostly-unchanged
+        # validator records every time — the reference gets this from its
+        # persistent-tree views (stateCache.ts); here a bounded memo buys
+        # ~4x on full-state merkleization without a tree layer.  One
+        # serialize (~no hashing) replaces ~2*fields sha256 compressions.
+        cache = cls.__dict__.get("_root_memo_")
+        if cache is None:
+            small_fixed = cls.is_fixed() and cls.fixed_size() <= 256
+            cache = {} if small_fixed else False
+            cls._root_memo_ = cache
+            if cache is not False:
+                # byte-budget bound: ~64 MB of keys per class (e.g. ~500k
+                # Validator records), not a raw entry count
+                cls._root_memo_cap_ = max(
+                    1 << 14, (64 << 20) // max(1, cls.fixed_size())
+                )
+        if cache is False:
+            return merkleize_chunks(cls.field_roots(value))
+        key = cls.serialize(value)
+        root = cache.get(key)
+        if root is None:
+            root = merkleize_chunks(cls.field_roots(value))
+            if len(cache) >= cls._root_memo_cap_:
+                # evict the OLDEST half (dict preserves insertion order):
+                # stale historical values go first, the hot working set
+                # mostly survives — a clear-all would make the next
+                # full-state merkleization revert to cold cost mid-import
+                for k in list(cache.keys())[: len(cache) // 2]:
+                    del cache[k]
+            cache[key] = root
+        return root
 
     def field_roots(cls, value) -> PyList[bytes]:
         """Per-field subtree roots — the container's merkle leaves (used
