@@ -547,10 +547,12 @@ class ContainerMeta(type):
             cache = {} if small_fixed else False
             cls._root_memo_ = cache
             if cache is not False:
-                # byte-budget bound: ~64 MB of keys per class (e.g. ~500k
-                # Validator records), not a raw entry count
+                # entry budget sized off real per-entry cost (key bytes +
+                # CPython bytes/dict overhead ~3x+200B): ~64 MB true RSS
+                # per class, ~115k Validator records
                 cls._root_memo_cap_ = max(
-                    1 << 14, (64 << 20) // max(1, cls.fixed_size())
+                    1 << 14,
+                    (64 << 20) // (3 * max(1, cls.fixed_size()) + 200),
                 )
         if cache is False:
             return merkleize_chunks(cls.field_roots(value))
@@ -558,14 +560,14 @@ class ContainerMeta(type):
         root = cache.get(key)
         if root is None:
             root = merkleize_chunks(cls.field_roots(value))
-            if len(cache) >= cls._root_memo_cap_:
-                # evict the OLDEST half (dict preserves insertion order):
-                # stale historical values go first, the hot working set
-                # mostly survives — a clear-all would make the next
-                # full-state merkleization revert to cold cost mid-import
-                for k in list(cache.keys())[: len(cache) // 2]:
-                    del cache[k]
-            cache[key] = root
+            # FREEZE when full rather than evict: full-state hashing
+            # scans the registry in the same order every time, so any
+            # eviction policy (FIFO/LRU) thrashes to ~0% hits once the
+            # live set exceeds the cap — keeping the first cap entries
+            # guarantees a cap/N hit rate and never makes hashing
+            # slower than uncached (miss cost = one serialize+lookup)
+            if len(cache) < cls._root_memo_cap_:
+                cache[key] = root
         return root
 
     def field_roots(cls, value) -> PyList[bytes]:
