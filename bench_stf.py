@@ -197,66 +197,11 @@ def main() -> None:
         flush=True,
     )
 
-    results = {}
-
-    # --- block processing ------------------------------------------------
-    block_mod, epoch_mod = processors_for(state)
-    block = build_block(cached)
-    times = []
-    for _ in range(3):
-        work = cached.clone()
-        t0 = time.perf_counter()
-        block_mod.process_block(
-            cfg, work.state, work.epoch_ctx, block, False
-        )
-        times.append(time.perf_counter() - t0)
-    block_s = min(times)
-    results["block"] = block_s
-    print(
-        json.dumps(
-            {
-                "metric": "stf_process_block_ms",
-                "value": round(block_s * 1e3, 1),
-                "unit": "ms",
-                "vs_baseline": round(BLOCK_CEILING_S / block_s, 2),
-                "ceiling_ms": BLOCK_CEILING_S * 1e3,
-                "attestations": len(block.body.attestations),
-            }
-        ),
-        flush=True,
-    )
-
-    # --- epoch processing ------------------------------------------------
-    from lodestar_tpu.params import ACTIVE_PRESET as P
-
-    times = []
-    for _ in range(2):
-        work = cached.clone()
-        work.state.slot = (int(work.state.slot) // P.SLOTS_PER_EPOCH + 1) * P.SLOTS_PER_EPOCH - 1
-        t0 = time.perf_counter()
-        epoch_mod.process_epoch(cfg, work.state, work.epoch_ctx)
-        work.state.slot += 1
-        work.epoch_ctx.rotate(work.state)
-        times.append(time.perf_counter() - t0)
-    epoch_s = min(times)
-    results["epoch"] = epoch_s
-    print(
-        json.dumps(
-            {
-                "metric": "stf_process_epoch_ms",
-                "value": round(epoch_s * 1e3, 1),
-                "unit": "ms",
-                "vs_baseline": round(EPOCH_CEILING_S / epoch_s, 2),
-                "ceiling_ms": EPOCH_CEILING_S * 1e3,
-            }
-        ),
-        flush=True,
-    )
-
-    # --- state merkleization ---------------------------------------------
-    # cold = first full hash (fills the small-container root memo);
-    # warm = the node's steady state (re-hash with the memo populated —
-    # what each block import actually pays)
+    # --- state merkleization first ---------------------------------------
+    # cold = first full hash (builds the incremental layer caches + fills
+    # the per-object root caches, ssz/incremental.py); warm = an
+    # unchanged-state re-hash.  Block/epoch measurements below then run
+    # against a warmed state — the node's steady state.
     t0 = time.perf_counter()
     state_hash_tree_root(cached.state)
     htr_cold_s = time.perf_counter() - t0
@@ -275,14 +220,89 @@ def main() -> None:
         flush=True,
     )
 
-    ok = block_s <= BLOCK_CEILING_S and epoch_s <= EPOCH_CEILING_S
+    # --- block import, end to end ----------------------------------------
+    # The reference's 500 ms block budget INCLUDES commit+hash
+    # (stateTransition.ts:89-93), so the honest number is
+    # clone + process_block + hashTreeRoot, not the STF alone.
+    block_mod, epoch_mod = processors_for(state)
+    block = build_block(cached)
+    e2e_times, stf_times, clone_times, htr_times = [], [], [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        work = cached.clone()
+        t1 = time.perf_counter()
+        block_mod.process_block(
+            cfg, work.state, work.epoch_ctx, block, False
+        )
+        t2 = time.perf_counter()
+        state_hash_tree_root(work.state)
+        t3 = time.perf_counter()
+        clone_times.append(t1 - t0)
+        stf_times.append(t2 - t1)
+        htr_times.append(t3 - t2)
+        e2e_times.append(t3 - t0)
+    block_s = min(stf_times)
+    block_e2e_s = min(e2e_times)
+    print(
+        json.dumps(
+            {
+                "metric": "stf_block_import_e2e_ms",
+                "value": round(block_e2e_s * 1e3, 1),
+                "unit": "ms",
+                "vs_baseline": round(BLOCK_CEILING_S / block_e2e_s, 2),
+                "ceiling_ms": BLOCK_CEILING_S * 1e3,
+                "clone_ms": round(min(clone_times) * 1e3, 1),
+                "stf_ms": round(block_s * 1e3, 1),
+                "htr_ms": round(min(htr_times) * 1e3, 1),
+                "attestations": len(block.body.attestations),
+            }
+        ),
+        flush=True,
+    )
+
+    # --- epoch processing, end to end ------------------------------------
+    from lodestar_tpu.params import ACTIVE_PRESET as P
+
+    e2e_times, stf_times = [], []
+    for _ in range(2):
+        work = cached.clone()
+        work.state.slot = (int(work.state.slot) // P.SLOTS_PER_EPOCH + 1) * P.SLOTS_PER_EPOCH - 1
+        t0 = time.perf_counter()
+        epoch_mod.process_epoch(cfg, work.state, work.epoch_ctx)
+        work.state.slot += 1
+        work.epoch_ctx.rotate(work.state)
+        t1 = time.perf_counter()
+        state_hash_tree_root(work.state)
+        t2 = time.perf_counter()
+        stf_times.append(t1 - t0)
+        e2e_times.append(t2 - t0)
+    epoch_s = min(stf_times)
+    epoch_e2e_s = min(e2e_times)
+    print(
+        json.dumps(
+            {
+                "metric": "stf_process_epoch_e2e_ms",
+                "value": round(epoch_e2e_s * 1e3, 1),
+                "unit": "ms",
+                "vs_baseline": round(EPOCH_CEILING_S / epoch_e2e_s, 2),
+                "ceiling_ms": EPOCH_CEILING_S * 1e3,
+                "stf_ms": round(epoch_s * 1e3, 1),
+                "htr_ms": round((epoch_e2e_s - epoch_s) * 1e3, 1),
+            }
+        ),
+        flush=True,
+    )
+
+    # honest one-line summary against the reference's ceilings
+    # (stateCache.ts:36-37: 500 ms block, 4 s epoch — hashing included)
+    ok = block_e2e_s <= BLOCK_CEILING_S and epoch_e2e_s <= EPOCH_CEILING_S
     print(
         json.dumps(
             {
                 "metric": "stf_within_reference_ceilings",
                 "value": bool(ok),
-                "block_ms": round(block_s * 1e3, 1),
-                "epoch_ms": round(epoch_s * 1e3, 1),
+                "block_import_e2e_ms": round(block_e2e_s * 1e3, 1),
+                "epoch_e2e_ms": round(epoch_e2e_s * 1e3, 1),
                 "validators": n,
             }
         ),

@@ -466,6 +466,19 @@ class ContainerMeta(type):
                     "module must NOT use `from __future__ import annotations`"
                 )
         cls._fields_ = fields
+        # Per-object root caching soundness class: every field holds an
+        # IMMUTABLE Python value (int/bool/bytes), so the only way the
+        # root can change is a field assignment — which __setattr__
+        # version-bumps.  Validator, Checkpoint, Fork, Eth1Data,
+        # BeaconBlockHeader... all qualify; anything holding a list or
+        # nested container does not (inner mutation bypasses the bump).
+        cls._shallow_fixed_ = bool(fields) and all(
+            isinstance(t, (Uint, Boolean, ByteVectorT)) for t in fields.values()
+        )
+        # frozen classes (set _frozen_ = True in the class body) are
+        # immutable records: field writes raise, copy() returns self, and
+        # the root is cached on the instance forever.
+        cls._frozen_ = bool(ns.get("_frozen_", getattr(cls, "_frozen_", False)))
         return cls
 
     # container classes themselves act as SszType descriptors -------------
@@ -534,13 +547,35 @@ class ContainerMeta(type):
         return cls(**kwargs)
 
     def hash_tree_root(cls, value) -> bytes:
-        # Value-keyed root memoization for SMALL FIXED containers
-        # (Validator, Checkpoint, ...): a root is a pure function of the
-        # value bytes, and big states hash the same 250k mostly-unchanged
-        # validator records every time — the reference gets this from its
-        # persistent-tree views (stateCache.ts); here a bounded memo buys
-        # ~4x on full-state merkleization without a tree layer.  One
-        # serialize (~no hashing) replaces ~2*fields sha256 compressions.
+        # Layered caching (the rebuild's answer to the reference's
+        # tree-backed views, stateCache.ts:30-110):
+        #   1. frozen records (Validator): root cached on the instance
+        #      forever — an unchanged validator costs one attr read.
+        #   2. shallow-fixed mutable containers (Checkpoint, Eth1Data,
+        #      BeaconBlockHeader...): root cached per (instance, version);
+        #      __setattr__ bumps the version.
+        #   3. value-keyed memo for small fixed containers: dedups across
+        #      object identities (deserialized copies of the same record).
+        #   4. big list/vector FIELDS: incremental layer caches — see
+        #      field_roots + ssz/incremental.py.
+        if cls._frozen_:
+            root = value.__dict__.get("_htr_")
+            if root is None:
+                root = cls._root_compute(value)
+                object.__setattr__(value, "_htr_", root)
+            return root
+        if cls._shallow_fixed_:
+            ver = value.__dict__.get("_v_", 0)
+            ent = value.__dict__.get("_htr_")
+            if ent is not None and ent[0] == ver:
+                return ent[1]
+            root = cls._root_compute(value)
+            object.__setattr__(value, "_htr_", (ver, root))
+            return root
+        return merkleize_chunks(cls.field_roots(value))
+
+    def _root_compute(cls, value) -> bytes:
+        """Root via the value-keyed memo (shared across instances)."""
         cache = cls.__dict__.get("_root_memo_")
         if cache is None:
             small_fixed = cls.is_fixed() and cls.fixed_size() <= 256
@@ -572,8 +607,33 @@ class ContainerMeta(type):
 
     def field_roots(cls, value) -> PyList[bytes]:
         """Per-field subtree roots — the container's merkle leaves (used
-        by ssz/proof.py for light-client branches)."""
-        return [t.hash_tree_root(getattr(value, n)) for n, t in cls._fields_.items()]
+        by ssz/proof.py for light-client branches).
+
+        Heavy list/vector fields (state.validators, balances, ...) are
+        lazily wrapped in a TrackedList here so their roots come from the
+        incremental layer cache (ssz/incremental.py) — per-block state
+        hashing is O(changed leaves), matching the reference's persistent
+        tree (stateCache.ts:30)."""
+        from . import incremental as _inc
+
+        if cls._frozen_:
+            # frozen records cache their WHOLE root on the instance
+            # (hash_tree_root above) — wrapping their fields would swap
+            # the immutable tuples installed by __init__ for mutable
+            # lists, breaking the frozen invariant and __eq__
+            return [t.hash_tree_root(getattr(value, n)) for n, t in cls._fields_.items()]
+        roots = []
+        for n, t in cls._fields_.items():
+            v = getattr(value, n)
+            if isinstance(v, _inc.TrackedList):
+                if v._stype_ is not t:
+                    v = _inc.ensure_tracked(value, n, t, v)
+                roots.append(_inc.commit(v))
+            elif isinstance(t, (ListT, VectorT)) and _inc.is_heavy(t, v):
+                roots.append(_inc.commit(_inc.ensure_tracked(value, n, t, v)))
+            else:
+                roots.append(t.hash_tree_root(v))
+        return roots
 
 
 class Container(metaclass=ContainerMeta):
@@ -585,34 +645,93 @@ class Container(metaclass=ContainerMeta):
     _fields_: Dict[str, SszType] = {}
 
     def __init__(self, **kwargs):
+        frozen = type(self)._frozen_
         for n, t in type(self)._fields_.items():
             if n in kwargs:
-                object.__setattr__(self, n, kwargs.pop(n))
+                v = kwargs.pop(n)
             else:
-                object.__setattr__(self, n, t.default())
+                v = t.default()
+            if frozen and isinstance(v, list):
+                # freeze list-valued fields too so per-object root caching
+                # is sound (nothing reachable from a frozen record mutates)
+                v = tuple(v)
+            object.__setattr__(self, n, v)
         if kwargs:
             raise TypeError(f"unknown fields: {sorted(kwargs)}")
 
     def __setattr__(self, name, value):
-        if name not in type(self)._fields_:
-            raise AttributeError(f"{type(self).__name__} has no SSZ field {name!r}")
+        cls = type(self)
+        if cls._frozen_:
+            raise AttributeError(
+                f"{cls.__name__} is frozen — build a new record with "
+                f".replace({name}=...) instead"
+            )
+        if name not in cls._fields_:
+            raise AttributeError(f"{cls.__name__} has no SSZ field {name!r}")
         object.__setattr__(self, name, value)
+        # version bump backing the per-object root cache (shallow-fixed
+        # classes); harmless elsewhere
+        object.__setattr__(self, "_v_", self.__dict__.get("_v_", 0) + 1)
+
+    def replace(self, **kwargs):
+        """New record with the given fields replaced (the mutation API for
+        frozen containers; works on any container)."""
+        fields = {n: getattr(self, n) for n in type(self)._fields_}
+        unknown = set(kwargs) - set(fields)
+        if unknown:
+            raise TypeError(f"unknown fields: {sorted(unknown)}")
+        fields.update(kwargs)
+        return type(self)(**fields)
 
     def copy(self):
-        """Deep value copy: nested containers and container-list elements
-        are copied recursively so no mutable object is shared with the
-        original (bytes/int/bool values are immutable and shared freely).
-        This is the correctness baseline; the structural-sharing fast path
-        belongs to a tree-backed view layer (reference stateCache.ts)."""
+        """Value copy with structural sharing where sound: frozen records
+        (and lists of them) are shared, tracked lists share their
+        committed merkle layers, mutable nested containers are copied.
+        The per-block state clone (state_transition.py:121) rides this —
+        the reference gets the same from persistent-tree views
+        (stateCache.ts)."""
+        if type(self)._frozen_:
+            return self
+        from . import incremental as _inc
+
         kwargs = {}
-        for n in type(self)._fields_:
+        for n, t in type(self)._fields_.items():
             v = getattr(self, n)
             if isinstance(v, Container):
                 v = v.copy()
-            elif isinstance(v, list):
-                v = [e.copy() if isinstance(e, Container) else e for e in v]
+            elif isinstance(v, (list, _inc.TrackedList)):
+                # element sharing is sound when elements are immutable:
+                # basic values, bytes, frozen records — the common case
+                # (validators, balances); only mutable container elements
+                # need copying
+                elem = getattr(t, "elem", None)
+                share = not isinstance(elem, ContainerMeta) or elem._frozen_
+                if isinstance(v, _inc.TrackedList):
+                    tl = v.copy_tracked()
+                    if not share:
+                        for i, e in enumerate(tl):
+                            if isinstance(e, Container) and not type(e)._frozen_:
+                                # same value ⇒ same root: bypass tracking
+                                list.__setitem__(tl, i, e.copy())
+                    v = tl
+                elif share:
+                    v = list(v)
+                else:
+                    v = [
+                        e.copy()
+                        if isinstance(e, Container) and not type(e)._frozen_
+                        else e
+                        for e in v
+                    ]
             kwargs[n] = v
-        return type(self)(**kwargs)
+        new = type(self)(**kwargs)
+        # carry a current per-object root across the copy (same value ⇒
+        # same root; fresh object starts at version 0)
+        ent = self.__dict__.get("_htr_")
+        if ent is not None and type(self)._shallow_fixed_:
+            if ent[0] == self.__dict__.get("_v_", 0):
+                object.__setattr__(new, "_htr_", (0, ent[1]))
+        return new
 
     def __eq__(self, other):
         if type(self) is not type(other):

@@ -1,0 +1,395 @@
+"""Incremental merkleization: O(changed-leaves · log N) hashTreeRoot.
+
+The reference gets per-block O(changes) state hashing from tree-backed
+views with structural sharing (@chainsafe/persistent-merkle-tree, consumed
+through state-transition/src/cache/stateCache.ts:30-110 — its design doc
+pins the ceilings the block budget assumes).  The rebuild keeps plain
+Python values (flat numpy epoch caches do the O(V) work instead of tree
+views), so the equivalent here is a LIST-LEVEL incremental merkleizer:
+
+- `TrackedList` — a drop-in `list` subclass that records which indices
+  were written (`state.balances[i] = x`, `state.validators[i] = v`,
+  `append`) since the last commit.  Any structural operation it cannot
+  attribute to indices (slice write, sort, ...) just flags a full
+  rebuild — correctness never depends on the tracking being complete.
+- `LayerStack` — the committed merkle layers of one list, an IMMUTABLE
+  snapshot.  `Container.copy()` shares it between the pre- and
+  post-state (structural sharing across the per-block clone in
+  state_transition.py:121); each copy accumulates its own dirty set and
+  the commit copy-on-writes only the layers it patches.
+- `commit()` — recomputes exactly the dirty chunks' root-paths with one
+  batched native sha256 call per level (ls_hash_pairs), or falls back to
+  a full layer-wise rebuild (ls_hash_layer) when most of the list
+  changed (e.g. the per-epoch balance update).
+
+Per-element roots for container/byte-vector elements come from the
+per-object root caches in ssz/core.py (frozen Validator records cache
+their root forever; shallow-fixed mutable containers cache per version),
+so an unchanged validator costs one attribute read, not a serialize.
+
+Spec: consensus-specs/ssz/simple-serialize.md merkleization; equivalence
+with the from-scratch `merkleize_chunks` is asserted by differential
+tests (tests/test_incremental_merkle.py).
+"""
+from __future__ import annotations
+
+from typing import List as PyList, Optional, Sequence, Set
+
+import numpy as np
+
+from lodestar_tpu import native as _native
+
+from . import core as _core
+
+ZERO_HASHES = _core.ZERO_HASHES
+_NATIVE = _native.available()
+
+# lists whose merkleization is at least this many chunks get a tracked
+# wrapper + layer cache on first hash; smaller ones stay on the direct path
+HEAVY_MIN_CHUNKS = 64
+
+
+def _hash_pairs_np(pairs: np.ndarray) -> np.ndarray:
+    """(k, 64) uint8 -> (k, 32) uint8 parent nodes."""
+    k = pairs.shape[0]
+    if _NATIVE:
+        out = _native.hash_pairs(pairs.tobytes())
+        return np.frombuffer(out, dtype=np.uint8).reshape(k, 32)
+    import hashlib
+
+    out = np.empty((k, 32), dtype=np.uint8)
+    buf = pairs.tobytes()
+    for i in range(k):
+        out[i] = np.frombuffer(
+            hashlib.sha256(buf[64 * i : 64 * i + 64]).digest(), dtype=np.uint8
+        )
+    return out
+
+
+def _hash_layer_np(layer: np.ndarray, level: int) -> np.ndarray:
+    """(n, 32) uint8 -> (ceil(n/2), 32); odd tail paired with the zero hash."""
+    n = layer.shape[0]
+    if _NATIVE:
+        out = _native.hash_layer(layer.tobytes(), ZERO_HASHES[level])
+        return np.frombuffer(out, dtype=np.uint8).reshape((n + 1) // 2, 32).copy()
+    if n % 2:
+        layer = np.concatenate(
+            [layer, np.frombuffer(ZERO_HASHES[level], dtype=np.uint8)[None, :]]
+        )
+    return _hash_pairs_np(layer.reshape(-1, 64))
+
+
+class LayerStack:
+    """Committed merkle layers of one list's chunk leaves (immutable).
+
+    layers[0] is the (count, 32) leaf array; layers[k+1] has
+    ceil(len(layers[k])/2) rows; the last layer has a single row — the
+    root of the next_pow2(count)-leaf occupied subtree.  Shared between
+    state copies; commit() builds a NEW stack, copy-on-writing only the
+    arrays it patches.
+    """
+
+    __slots__ = ("layers", "count")
+
+    def __init__(self, layers: PyList[np.ndarray], count: int):
+        self.layers = layers
+        self.count = count
+
+    @staticmethod
+    def build(leaves: np.ndarray) -> "LayerStack":
+        """Full layer-wise rebuild from a (n, 32) uint8 leaf array."""
+        n = leaves.shape[0]
+        layers = [leaves]
+        level = 0
+        cur = leaves
+        while cur.shape[0] > 1:
+            cur = _hash_layer_np(cur, level)
+            layers.append(cur)
+            level += 1
+        return LayerStack(layers, n)
+
+    def subtree_root(self) -> bytes:
+        if self.count == 0:
+            return _core.ZERO_CHUNK
+        return self.layers[-1][0].tobytes()
+
+    def subtree_depth(self) -> int:
+        return len(self.layers) - 1
+
+    def patch(self, leaves: np.ndarray, dirty: Sequence[int]) -> "LayerStack":
+        """New stack with `dirty` leaf rows replaced / appended.
+
+        `leaves` is the FULL new (n, 32) leaf array (n >= self.count is a
+        grow, dirty must cover the appended rows); only dirty root-paths
+        are rehashed, one batched native call per level.
+        """
+        n = leaves.shape[0]
+        depth = max(1, _core._next_pow2(n)).bit_length() - 1
+        new_layers: PyList[np.ndarray] = [leaves]
+        dirty_idx = np.unique(np.asarray(sorted(dirty), dtype=np.int64))
+        cur = leaves
+        for level in range(depth):
+            parents = np.unique(dirty_idx >> 1)
+            below = cur
+            nb = below.shape[0]
+            left = below[np.minimum(parents * 2, nb - 1)]
+            right_i = parents * 2 + 1
+            in_range = right_i < nb
+            right = below[np.minimum(right_i, nb - 1)].copy()
+            if not in_range.all():
+                right[~in_range] = np.frombuffer(ZERO_HASHES[level], dtype=np.uint8)
+            pairs = np.concatenate([left, right], axis=1)
+            hashed = _hash_pairs_np(pairs)
+            n_up = (nb + 1) // 2
+            if level + 1 < len(self.layers) and self.layers[level + 1].shape[0] == n_up:
+                up = self.layers[level + 1].copy()
+            else:
+                old = (
+                    self.layers[level + 1]
+                    if level + 1 < len(self.layers)
+                    else np.empty((0, 32), dtype=np.uint8)
+                )
+                up = np.empty((n_up, 32), dtype=np.uint8)
+                m = min(old.shape[0], n_up)
+                up[:m] = old[:m]
+            up[parents] = hashed
+            new_layers.append(up)
+            dirty_idx = parents
+            cur = up
+        return LayerStack(new_layers, n)
+
+
+def _chain_to_limit(root: bytes, occupied_depth: int, limit_depth: int) -> bytes:
+    for level in range(occupied_depth, limit_depth):
+        root = _core.hash_nodes(root, ZERO_HASHES[level])
+    return root
+
+
+class TrackedList(list):
+    """list subclass recording written indices for incremental HTR.
+
+    Wrapped lazily by ContainerMeta.field_roots when a field's
+    merkleization is heavy; every STF mutation path (index write, append)
+    lands here because the wrapper IS the field value.  Operations that
+    cannot be mapped to indices set `_force_` and the next commit
+    rebuilds — tracking completeness is a performance property only.
+    """
+
+    __slots__ = ("_dirty_", "_snap_", "_stype_", "_force_", "_clen_")
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self._dirty_: Set[int] = set()
+        self._snap_: Optional[LayerStack] = None
+        self._stype_ = None
+        self._force_ = False
+        self._clen_ = 0  # element count at last commit (appends extend past it)
+
+    # -- tracked mutations -------------------------------------------------
+    def __setitem__(self, i, v):
+        if isinstance(i, slice):
+            self._force_ = True
+        else:
+            if i < 0:
+                i += len(self)
+            self._dirty_.add(i)
+        super().__setitem__(i, v)
+
+    # append/extend need no bookkeeping: commit treats rows past the
+    # committed count as dirty by construction
+
+    def __delitem__(self, i):
+        self._force_ = True
+        super().__delitem__(i)
+
+    def insert(self, i, v):
+        self._force_ = True
+        super().insert(i, v)
+
+    def pop(self, i=-1):
+        self._force_ = True
+        return super().pop(i)
+
+    def remove(self, v):
+        self._force_ = True
+        super().remove(v)
+
+    def clear(self):
+        self._force_ = True
+        super().clear()
+
+    def reverse(self):
+        self._force_ = True
+        super().reverse()
+
+    def sort(self, **kw):
+        self._force_ = True
+        super().sort(**kw)
+
+    def __imul__(self, n):
+        self._force_ = True
+        return super().__imul__(n)
+
+    def copy_tracked(self) -> "TrackedList":
+        """Value copy sharing the committed layer snapshot (structural
+        sharing across the per-block state clone)."""
+        new = TrackedList(self)
+        new._snap_ = self._snap_
+        new._stype_ = self._stype_
+        new._force_ = self._force_
+        new._dirty_ = set(self._dirty_)
+        new._clen_ = self._clen_
+        return new
+
+
+# -- leaf encoding ----------------------------------------------------------
+
+
+def _basic_chunk_bytes(stype, values, start_chunk: int, end_chunk: int) -> bytes:
+    """Serialized chunks [start, end) of a basic-element sequence."""
+    elem = stype.elem
+    size = elem.fixed_size()
+    per = 32 // size
+    lo = start_chunk * per
+    hi = min(len(values), end_chunk * per)
+    if size == 8:
+        arr = np.array(values[lo:hi], dtype="<u8")
+    elif size == 1:
+        arr = np.array(values[lo:hi], dtype=np.uint8)
+    else:
+        data = b"".join(elem.serialize(v) for v in values[lo:hi])
+        arr = np.frombuffer(data, dtype=np.uint8)
+    buf = arr.tobytes()
+    want = (end_chunk - start_chunk) * 32
+    if len(buf) < want:
+        buf += b"\x00" * (want - len(buf))
+    return buf
+
+
+def _leaf_array(stype, values) -> np.ndarray:
+    """Full (nchunks, 32) uint8 leaf array for the current values."""
+    elem = stype.elem
+    if _core._is_basic(elem):
+        per = 32 // elem.fixed_size()
+        nchunks = (len(values) + per - 1) // per
+        buf = _basic_chunk_bytes(stype, values, 0, nchunks)
+        return np.frombuffer(buf, dtype=np.uint8).reshape(nchunks, 32).copy()
+    if isinstance(elem, _core.ByteVectorT) and elem.length == 32:
+        if len(values) == 0:
+            return np.empty((0, 32), dtype=np.uint8)
+        buf = b"".join(bytes(v) for v in values)
+        return np.frombuffer(buf, dtype=np.uint8).reshape(len(values), 32).copy()
+    roots = b"".join(elem.hash_tree_root(v) for v in values)
+    out = np.frombuffer(roots, dtype=np.uint8)
+    return out.reshape(len(values), 32).copy() if len(values) else np.empty((0, 32), dtype=np.uint8)
+
+
+def _elem_root(stype, v) -> bytes:
+    elem = stype.elem
+    if isinstance(elem, _core.ByteVectorT) and elem.length == 32:
+        return bytes(v)
+    return elem.hash_tree_root(v)
+
+
+def _limit_chunks(stype) -> int:
+    """Padded leaf-count ceiling of the type's merkleization."""
+    elem = stype.elem
+    if isinstance(stype, _core.ListT):
+        if _core._is_basic(elem):
+            return _core._next_pow2((stype.limit * elem.fixed_size() + 31) // 32)
+        return _core._next_pow2(stype.limit)
+    # Vector: padded to next_pow2 of its own chunk count
+    if _core._is_basic(elem):
+        return _core._next_pow2((stype.length * elem.fixed_size() + 31) // 32)
+    return _core._next_pow2(stype.length)
+
+
+def is_heavy(stype, value) -> bool:
+    """Wrap-worthy?  Fixed-element list/vector whose CURRENT merkleization
+    is at least HEAVY_MIN_CHUNKS chunks, with elements the tracker can
+    treat as values: basic ints/bools, byte vectors, or FROZEN containers.
+    Mutable container elements (Eth1Data, ...) can change in place without
+    the list seeing a dirty index — those stay on the direct path, and
+    variable-size elements change their own chunk footprint in place."""
+    if not isinstance(stype, (_core.ListT, _core.VectorT)):
+        return False
+    elem = stype.elem
+    if not elem.is_fixed():
+        return False
+    if isinstance(elem, _core.ContainerMeta) and not elem._frozen_:
+        return False
+    if _core._is_basic(elem):
+        per = 32 // elem.fixed_size() if elem.fixed_size() <= 32 else 1
+        nchunks = (len(value) + per - 1) // per if per else len(value)
+    else:
+        nchunks = len(value)
+    return nchunks >= HEAVY_MIN_CHUNKS
+
+
+def commit(tl: TrackedList) -> bytes:
+    """Root of the tracked list, patching the committed snapshot."""
+    stype = tl._stype_
+    elem = stype.elem
+    basic = _core._is_basic(elem)
+    per = (32 // elem.fixed_size()) if basic else 1
+    n = len(tl)
+    nchunks = (n + per - 1) // per
+    snap = tl._snap_
+
+    rebuild = (
+        snap is None
+        or tl._force_
+        or snap.count == 0
+        or nchunks < snap.count
+    )
+    if not rebuild:
+        dirty_chunks = {i // per for i in tl._dirty_ if i // per < snap.count}
+        # appends since the last commit: every chunk from the one holding
+        # the old tail element onward (a partially-filled tail chunk
+        # changes content when elements pack into it)
+        dirty_chunks.update(range(min(tl._clen_ // per, snap.count), nchunks))
+        if len(dirty_chunks) * max(1, snap.subtree_depth()) >= max(64, nchunks):
+            rebuild = True
+    if rebuild:
+        stack = LayerStack.build(_leaf_array(stype, tl))
+    elif not dirty_chunks:
+        stack = snap
+    else:
+        leaves = snap.layers[0]
+        if nchunks != snap.count:
+            grown = np.empty((nchunks, 32), dtype=np.uint8)
+            grown[: snap.count] = leaves
+            leaves = grown
+        else:
+            leaves = leaves.copy()
+        if basic:
+            for c in dirty_chunks:
+                leaves[c] = np.frombuffer(
+                    _basic_chunk_bytes(stype, tl, c, c + 1), dtype=np.uint8
+                )
+        else:
+            for c in dirty_chunks:
+                leaves[c] = np.frombuffer(_elem_root(stype, tl[c]), dtype=np.uint8)
+        stack = snap.patch(leaves, sorted(dirty_chunks))
+
+    tl._snap_ = stack
+    tl._dirty_.clear()
+    tl._force_ = False
+    tl._clen_ = n
+
+    limit = _limit_chunks(stype)
+    limit_depth = max(0, limit.bit_length() - 1)
+    root = _chain_to_limit(stack.subtree_root(), stack.subtree_depth(), limit_depth)
+    if isinstance(stype, _core.ListT):
+        root = _core.mix_in_length(root, n)
+    return root
+
+
+def ensure_tracked(container, name: str, stype, value) -> TrackedList:
+    """Wrap `container.name` in a TrackedList bound to its SSZ type."""
+    if isinstance(value, TrackedList) and value._stype_ is stype:
+        return value
+    tl = TrackedList(value)
+    tl._stype_ = stype
+    object.__setattr__(container, name, tl)
+    return tl

@@ -152,10 +152,12 @@ def process_bls_to_execution_change(
         get_bls_to_execution_change_signature_set(cfg, state, signed_change)
     ):
         raise ValueError("bls_to_execution_change: invalid signature")
-    v.withdrawal_credentials = (
-        bytes([ETH1_ADDRESS_WITHDRAWAL_PREFIX])
-        + b"\x00" * 11
-        + bytes(change.to_execution_address)
+    state.validators[change.validator_index] = v.replace(
+        withdrawal_credentials=(
+            bytes([ETH1_ADDRESS_WITHDRAWAL_PREFIX])
+            + b"\x00" * 11
+            + bytes(change.to_execution_address)
+        )
     )
 
 

@@ -153,9 +153,11 @@ def initiate_validator_exit(cfg, state, epoch_ctx, index: int) -> None:
         epoch_ctx.exit_queue_epoch += 1
         epoch_ctx.exit_queue_churn = 0
     epoch_ctx.exit_queue_churn += 1
-    v.exit_epoch = epoch_ctx.exit_queue_epoch
-    v.withdrawable_epoch = (
-        epoch_ctx.exit_queue_epoch + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    state.validators[index] = v.replace(
+        exit_epoch=epoch_ctx.exit_queue_epoch,
+        withdrawable_epoch=(
+            epoch_ctx.exit_queue_epoch + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        ),
     )
 
 
@@ -165,9 +167,11 @@ def slash_validator(
     epoch = compute_epoch_at_slot(state.slot)
     initiate_validator_exit(cfg, state, epoch_ctx, index)
     v = state.validators[index]
-    v.slashed = True
-    v.withdrawable_epoch = max(
-        v.withdrawable_epoch, epoch + _p.EPOCHS_PER_SLASHINGS_VECTOR
+    v = state.validators[index] = v.replace(
+        slashed=True,
+        withdrawable_epoch=max(
+            v.withdrawable_epoch, epoch + _p.EPOCHS_PER_SLASHINGS_VECTOR
+        ),
     )
     state.slashings[epoch % _p.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
     # fork-dependent quotients (altair/bellatrix "Modified slash_validator")

@@ -286,7 +286,9 @@ def process_registry_updates(cfg, state, proc: EpochProcess, epoch_ctx: EpochCon
             v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
             and v.effective_balance == _p.MAX_EFFECTIVE_BALANCE
         ):
-            v.activation_eligibility_epoch = epoch + 1
+            v = state.validators[i] = v.replace(
+                activation_eligibility_epoch=epoch + 1
+            )
         if (
             proc.is_active_curr[i]
             and v.effective_balance <= cfg.EJECTION_BALANCE
@@ -306,7 +308,9 @@ def process_registry_updates(cfg, state, proc: EpochProcess, epoch_ctx: EpochCon
     )
     churn = get_validator_churn_limit(cfg, int(proc.is_active_curr.sum()))
     for i in queue[:churn]:
-        state.validators[i].activation_epoch = compute_activation_exit_epoch(epoch)
+        state.validators[i] = state.validators[i].replace(
+            activation_epoch=compute_activation_exit_epoch(epoch)
+        )
 
 
 def process_slashings(cfg, state, proc: EpochProcess) -> None:
@@ -344,8 +348,10 @@ def process_effective_balance_updates(cfg, state, proc: EpochProcess) -> None:
             balance + down < v.effective_balance
             or v.effective_balance + up < balance
         ):
-            v.effective_balance = min(
-                balance - balance % increment, _p.MAX_EFFECTIVE_BALANCE
+            state.validators[i] = v.replace(
+                effective_balance=min(
+                    balance - balance % increment, _p.MAX_EFFECTIVE_BALANCE
+                )
             )
 
 

@@ -77,11 +77,12 @@ def apply_deposits(
             balance - balance % _p.EFFECTIVE_BALANCE_INCREMENT,
             _p.MAX_EFFECTIVE_BALANCE,
         )
-        v.effective_balance = eff
+        kw = {"effective_balance": eff}
         if eff == _p.MAX_EFFECTIVE_BALANCE:
-            v.activation_eligibility_epoch = GENESIS_EPOCH
-            v.activation_epoch = GENESIS_EPOCH
+            kw["activation_eligibility_epoch"] = GENESIS_EPOCH
+            kw["activation_epoch"] = GENESIS_EPOCH
             activated += 1
+        state.validators[i] = v.replace(**kw)
 
     validators_t = ssz.phase0.BeaconState._fields_["validators"]
     state.genesis_validators_root = validators_t.hash_tree_root(state.validators)

@@ -28,6 +28,10 @@ EpochParticipation = List[uint8, _p.VALIDATOR_REGISTRY_LIMIT]
 
 
 class SyncCommittee(Container):
+    # frozen: committees are replaced wholesale at period boundaries;
+    # freezing makes the per-object root cache sound (pubkeys becomes a
+    # tuple at construction)
+    _frozen_ = True
     pubkeys: Vector[Bytes48, _p.SYNC_COMMITTEE_SIZE]
     aggregate_pubkey: Bytes48
 

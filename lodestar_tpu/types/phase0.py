@@ -61,6 +61,11 @@ class Checkpoint(Container):
 
 
 class Validator(Container):
+    # frozen: registry records are immutable values mutated via .replace()
+    # so state copies share them and their roots cache per object
+    # (the rebuild's analogue of the reference's tree-view structural
+    # sharing, state-transition/src/cache/stateCache.ts:30)
+    _frozen_ = True
     pubkey: BLSPubkey
     withdrawal_credentials: Bytes32
     effective_balance: Gwei

@@ -1,0 +1,186 @@
+"""Differential tests: incremental merkleization == from-scratch SSZ.
+
+The incremental layer (ssz/incremental.py) must be bit-identical to the
+naive merkleizer for every op sequence the STF can produce: index
+writes, appends, bulk rewrites, shrink/regrow, and state clones sharing
+committed layers.  Mirrors the reference's persistent-merkle-tree unit
+strategy (packages/persistent-merkle-tree/test/tree.test.ts): mutate,
+commit, compare against a freshly built tree.
+"""
+import random
+
+import pytest
+
+from lodestar_tpu.ssz import core as ssz
+from lodestar_tpu.ssz import incremental as inc
+from lodestar_tpu.types import ssz as types
+
+pytestmark = pytest.mark.fast
+
+
+def _naive_list_root(stype, values):
+    """From-scratch root via the plain (untracked) type path."""
+    return stype.hash_tree_root(list(values))
+
+
+def _committed_root(stype, tl):
+    return inc.commit(tl)
+
+
+def _wrap(stype, values):
+    tl = inc.TrackedList(values)
+    tl._stype_ = stype
+    return tl
+
+
+@pytest.mark.parametrize("limit", [100, 1 << 12, 1 << 40])
+def test_uint64_list_random_ops(limit):
+    rng = random.Random(7)
+    stype = ssz.ListT(ssz.uint64, limit)
+    tl = _wrap(stype, [rng.randrange(2**64) for _ in range(90)])
+    assert _committed_root(stype, tl) == _naive_list_root(stype, tl)
+    for round_ in range(12):
+        op = rng.choice(["set", "append", "bulk", "clone"])
+        if op == "set":
+            for _ in range(rng.randrange(1, 9)):
+                tl[rng.randrange(len(tl))] = rng.randrange(2**64)
+        elif op == "append":
+            for _ in range(rng.randrange(1, 30)):
+                if len(tl) < 200:
+                    tl.append(rng.randrange(2**64))
+        elif op == "bulk":
+            for i in range(len(tl)):
+                tl[i] = rng.randrange(2**64)
+        else:
+            tl = tl.copy_tracked()
+            tl[rng.randrange(len(tl))] = rng.randrange(2**64)
+        assert _committed_root(stype, tl) == _naive_list_root(stype, tl), (
+            f"mismatch after {op} round {round_}"
+        )
+
+
+def test_uint64_vector_and_bytes32_vector():
+    rng = random.Random(11)
+    vt = ssz.VectorT(ssz.uint64, 128)
+    tl = _wrap(vt, [rng.randrange(2**64) for _ in range(128)])
+    assert _committed_root(vt, tl) == _naive_list_root(vt, tl)
+    tl[5] = 1
+    tl[127] = 2
+    assert _committed_root(vt, tl) == _naive_list_root(vt, tl)
+
+    bt = ssz.VectorT(ssz.Bytes32, 256)
+    vals = [bytes([i]) * 32 for i in range(256)]
+    tl = _wrap(bt, vals)
+    assert _committed_root(bt, tl) == _naive_list_root(bt, tl)
+    tl[0] = b"\xaa" * 32
+    tl[255] = b"\xbb" * 32
+    assert _committed_root(bt, tl) == _naive_list_root(bt, tl)
+
+
+def test_container_element_list_tracks_replacement():
+    Validator = types.phase0.Validator
+    stype = ssz.ListT(Validator, 1 << 40)
+    vals = [
+        Validator(pubkey=bytes([i]) * 48, effective_balance=32 * 10**9)
+        for i in range(70)
+    ]
+    tl = _wrap(stype, vals)
+    r0 = _committed_root(stype, tl)
+    assert r0 == _naive_list_root(stype, tl)
+    tl[3] = tl[3].replace(slashed=True)
+    tl.append(Validator(pubkey=b"\x99" * 48))
+    assert _committed_root(stype, tl) == _naive_list_root(stype, tl)
+
+
+def test_untrackable_ops_force_full_rebuild():
+    stype = ssz.ListT(ssz.uint64, 1 << 20)
+    tl = _wrap(stype, list(range(100)))
+    _committed_root(stype, tl)
+    tl.pop()
+    tl.sort(reverse=True)
+    del tl[0]
+    tl[0:2] = [7, 8]
+    assert _committed_root(stype, tl) == _naive_list_root(stype, tl)
+
+
+def test_shrink_then_regrow():
+    stype = ssz.ListT(ssz.uint8, 1 << 20)
+    tl = _wrap(stype, [1] * 300)
+    _committed_root(stype, tl)
+    tl.clear()
+    assert _committed_root(stype, tl) == _naive_list_root(stype, tl)
+    tl.extend([5] * 40)
+    assert _committed_root(stype, tl) == _naive_list_root(stype, tl)
+
+
+def test_frozen_validator_semantics():
+    Validator = types.phase0.Validator
+    v = Validator(pubkey=b"\x01" * 48)
+    with pytest.raises(AttributeError):
+        v.slashed = True
+    v2 = v.replace(slashed=True)
+    assert v2.slashed and not v.slashed
+    assert v.copy() is v
+    # root cached on the instance, replace() gets a fresh root
+    assert Validator.hash_tree_root(v) == Validator.hash_tree_root(v)
+    assert Validator.hash_tree_root(v2) != Validator.hash_tree_root(v)
+
+
+def test_shallow_fixed_version_cache():
+    Checkpoint = types.phase0.Checkpoint
+    c = Checkpoint(epoch=1, root=b"\x11" * 32)
+    r1 = Checkpoint.hash_tree_root(c)
+    c.epoch = 2
+    r2 = Checkpoint.hash_tree_root(c)
+    assert r1 != r2
+    c.epoch = 1
+    assert Checkpoint.hash_tree_root(c) == r1
+
+
+def test_frozen_container_fields_stay_tuples_after_hashing():
+    # regression: lazy TrackedList wrapping must not un-freeze a frozen
+    # container's tuple field (SyncCommittee.pubkeys is heavy enough)
+    SyncCommittee = types.altair.SyncCommittee
+    n = len(SyncCommittee._fields_["pubkeys"].default())
+    sc = SyncCommittee(pubkeys=[bytes([1]) * 48] * n, aggregate_pubkey=b"\x02" * 48)
+    sc2 = SyncCommittee(pubkeys=[bytes([1]) * 48] * n, aggregate_pubkey=b"\x02" * 48)
+    SyncCommittee.hash_tree_root(sc)
+    assert isinstance(sc.pubkeys, tuple)
+    assert sc == sc2
+    with pytest.raises(TypeError):
+        sc.pubkeys[0] = b"\xff" * 48
+
+
+def test_mutable_container_element_lists_never_go_stale():
+    # regression: lists of MUTABLE containers must not be tracked — an
+    # in-place element mutation bumps the element's version but records
+    # no dirty index, so a tracked list would reuse the stale leaf
+    Eth1Data = types.phase0.Eth1Data
+    stype = ssz.ListT(Eth1Data, 2048)
+    vals = [Eth1Data(deposit_root=bytes([i]) * 32, deposit_count=i) for i in range(70)]
+    assert inc.is_heavy(stype, vals) is False  # must NOT be tracked
+    r1 = stype.hash_tree_root(vals)
+    vals[0].deposit_count = 999
+    assert stype.hash_tree_root(vals) != r1  # in-place mutation seen
+
+
+def test_state_field_roots_wrap_heavy_fields_and_clone_shares_layers():
+    st = types.phase0.BeaconState.default()
+    Validator = types.phase0.Validator
+    for i in range(80):
+        st.validators.append(Validator(pubkey=bytes([i]) * 48))
+        st.balances.append(32 * 10**9)
+    r_plain = ssz.merkleize_chunks(
+        [t.hash_tree_root(getattr(st, n)) for n, t in type(st)._fields_.items()]
+    )
+    r1 = types.phase0.BeaconState.hash_tree_root(st)
+    assert r1 == r_plain
+    assert isinstance(st.validators, inc.TrackedList)  # wrapped lazily
+    # clone shares committed layers; divergent mutations stay independent
+    st2 = st.copy()
+    st2.balances[0] = 1
+    r2 = types.phase0.BeaconState.hash_tree_root(st2)
+    assert types.phase0.BeaconState.hash_tree_root(st) == r1
+    assert r2 != r1
+    st.balances[0] = 1
+    assert types.phase0.BeaconState.hash_tree_root(st) == r2

@@ -138,14 +138,16 @@ def test_expected_withdrawals_sweep():
     # interop validators use BLS credentials -> no withdrawals
     assert get_expected_withdrawals(st) == []
     # flip validator 3 to eth1 credentials with excess balance -> partial
-    st.validators[3].withdrawal_credentials = b"\x01" + b"\x00" * 11 + b"\xbb" * 20
+    st.validators[3] = st.validators[3].replace(
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + b"\xbb" * 20
+    )
     st.balances[3] = _p.MAX_EFFECTIVE_BALANCE + 5
     ws = get_expected_withdrawals(st)
     assert len(ws) == 1
     assert ws[0].validator_index == 3 and ws[0].amount == 5
     assert bytes(ws[0].address) == b"\xbb" * 20
     # fully withdrawable: withdrawable_epoch passed
-    st.validators[3].withdrawable_epoch = 0
+    st.validators[3] = st.validators[3].replace(withdrawable_epoch=0)
     ws = get_expected_withdrawals(st)
     assert ws[0].amount == st.balances[3]
 
@@ -153,7 +155,9 @@ def test_expected_withdrawals_sweep():
 def test_withdrawals_processed_in_block():
     dc = _capella_chain()
     st = dc.head.state
-    st.validators[2].withdrawal_credentials = b"\x01" + b"\x00" * 11 + b"\xcc" * 20
+    st.validators[2] = st.validators[2].replace(
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + b"\xcc" * 20
+    )
     st.balances[2] = _p.MAX_EFFECTIVE_BALANCE + 1_000_000
     dc.run_until(2, verify_signatures=False)
     st = dc.head.state
@@ -205,8 +209,9 @@ def test_bls_to_execution_change():
     with pytest.raises(ValueError):
         process_bls_to_execution_change(cfg, st, signed)
     # wrong signer rejected
-    st.validators[6].withdrawal_credentials = (
-        b"\x00" + hashlib.sha256(dc.sks[6].to_public_key().to_bytes()).digest()[1:]
+    st.validators[6] = st.validators[6].replace(
+        withdrawal_credentials=b"\x00"
+        + hashlib.sha256(dc.sks[6].to_public_key().to_bytes()).digest()[1:]
     )
     bad = ssz.capella.SignedBLSToExecutionChange(
         message=ssz.capella.BLSToExecutionChange(
