@@ -116,7 +116,14 @@ class ApiClient:
             randao_reveal="0x" + randao_reveal.hex(),
             graffiti=graffiti,
         )
-        return from_json(ssz.phase0.BeaconBlock, body["data"])
+        # fork-aware decode via the response's version field (the
+        # reference's getForkTypes(version) pattern) — an altair+ block
+        # decoded as phase0 would silently drop sync_aggregate
+        from lodestar_tpu.params import ForkName
+        from lodestar_tpu.types import types_for
+
+        fork = ForkName(body.get("version", "phase0"))
+        return from_json(types_for(fork)[1], body["data"])
 
     async def produce_attestation_data(self, slot: int, committee_index: int):
         body = await self._get(
@@ -139,6 +146,86 @@ class ApiClient:
             "/eth/v1/validator/aggregate_and_proofs",
             [to_json(ssz.phase0.SignedAggregateAndProof, s) for s in signed_aggs],
         )
+
+    async def prepare_beacon_proposer(self, entries: List[dict]) -> None:
+        """POST prepare_beacon_proposer: [{validator_index, fee_recipient}]."""
+        payload = [
+            {
+                "validator_index": str(e["validator_index"]),
+                "fee_recipient": "0x" + bytes(e["fee_recipient"]).hex(),
+            }
+            for e in entries
+        ]
+        await self._post("/eth/v1/validator/prepare_beacon_proposer", payload)
+
+    # blinded / builder flow (routes/validator.ts:168,248) ----------------
+
+    async def produce_blinded_block(
+        self, slot: int, randao_reveal: bytes, graffiti: str = ""
+    ):
+        body = await self._get(
+            f"/eth/v1/validator/blinded_blocks/{slot}",
+            randao_reveal="0x" + randao_reveal.hex(),
+            graffiti=graffiti,
+        )
+        from lodestar_tpu.params import ForkName
+        from lodestar_tpu.types import blinded_types_for
+
+        fork = ForkName(body.get("version", "bellatrix"))
+        return from_json(blinded_types_for(fork)[0], body["data"])
+
+    async def publish_blinded_block(self, signed_blinded) -> None:
+        await self._post(
+            "/eth/v1/beacon/blinded_blocks",
+            to_json(type(signed_blinded), signed_blinded),
+        )
+
+    # sync-committee validator flow (routes/validator.ts:245-249) --------
+
+    async def get_sync_duties(self, epoch: int, indices: List[int]) -> List[dict]:
+        return (
+            await self._post(
+                f"/eth/v1/validator/duties/sync/{epoch}", [str(i) for i in indices]
+            )
+        )["data"]
+
+    async def submit_pool_sync_committee_messages(self, messages) -> None:
+        await self._post(
+            "/eth/v1/beacon/pool/sync_committees",
+            [to_json(ssz.altair.SyncCommitteeMessage, m) for m in messages],
+        )
+
+    async def produce_sync_committee_contribution(
+        self, slot: int, subcommittee_index: int, beacon_block_root: bytes
+    ):
+        data = (
+            await self._get(
+                "/eth/v1/validator/sync_committee_contribution",
+                slot=str(slot),
+                subcommittee_index=str(subcommittee_index),
+                beacon_block_root="0x" + beacon_block_root.hex(),
+            )
+        )["data"]
+        return from_json(ssz.altair.SyncCommitteeContribution, data)
+
+    async def submit_contribution_and_proofs(self, signed) -> None:
+        await self._post(
+            "/eth/v1/validator/contribution_and_proofs",
+            [to_json(ssz.altair.SignedContributionAndProof, s) for s in signed],
+        )
+
+    async def prepare_sync_committee_subnets(self, subs: List[dict]) -> None:
+        payload = [
+            {
+                "validator_index": str(s["validator_index"]),
+                "sync_committee_indices": [
+                    str(i) for i in s["sync_committee_indices"]
+                ],
+                "until_epoch": str(s.get("until_epoch", 0)),
+            }
+            for s in subs
+        ]
+        await self._post("/eth/v1/validator/sync_committee_subscriptions", payload)
 
     async def prepare_beacon_committee_subnet(self, subs: List[dict]) -> None:
         """POST beacon_committee_subscriptions (attestationDuties.ts

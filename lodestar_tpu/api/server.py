@@ -39,7 +39,10 @@ def _err(code: int, message: str) -> web.Response:
 class BeaconRestApiServer:
     """chain+db+network -> HTTP (BeaconRestApiServer role)."""
 
-    def __init__(self, chain, db, network=None, sync=None, light_client_server=None):
+    def __init__(
+        self, chain, db, network=None, sync=None, light_client_server=None,
+        builder=None,
+    ):
         self.light_client_server = light_client_server
         from lodestar_tpu.types import signed_block_wire_codec
 
@@ -48,6 +51,11 @@ class BeaconRestApiServer:
         self.db = db
         self.network = network
         self.sync = sync
+        self.builder = builder  # MEV builder API (HttpBuilderApi / MockBuilder)
+        # prepareBeaconProposer registrations: proposer index -> fee
+        # recipient, consumed by local payload production
+        # (validator/src/services/prepareBeaconProposer.ts counterpart)
+        self.fee_recipients: dict = {}
         self.app = web.Application()
         self._event_queues: list = []
         self._routes()
@@ -103,6 +111,11 @@ class BeaconRestApiServer:
         r.add_get("/eth/v1/validator/duties/proposer/{epoch}", self.get_proposer_duties)
         r.add_post("/eth/v1/validator/duties/attester/{epoch}", self.post_attester_duties)
         r.add_get("/eth/v2/validator/blocks/{slot}", self.produce_block)
+        # blinded / builder flow (routes/validator.ts:168, beacon.ts blinded_blocks)
+        r.add_get(
+            "/eth/v1/validator/blinded_blocks/{slot}", self.produce_blinded_block
+        )
+        r.add_post("/eth/v1/beacon/blinded_blocks", self.post_blinded_block)
         r.add_get("/eth/v1/validator/attestation_data", self.produce_attestation_data)
         r.add_get("/eth/v1/validator/aggregate_attestation", self.get_aggregate)
         r.add_post("/eth/v1/validator/aggregate_and_proofs", self.post_aggregate_and_proofs)
@@ -110,6 +123,25 @@ class BeaconRestApiServer:
             "/eth/v1/validator/beacon_committee_subscriptions",
             self.post_committee_subscriptions,
         )
+        # sync-committee validator flow (beacon/routes/validator.ts:245-249)
+        r.add_post("/eth/v1/validator/duties/sync/{epoch}", self.post_sync_duties)
+        r.add_post(
+            "/eth/v1/validator/prepare_beacon_proposer",
+            self.post_prepare_beacon_proposer,
+        )
+        r.add_get(
+            "/eth/v1/validator/sync_committee_contribution",
+            self.get_sync_committee_contribution,
+        )
+        r.add_post(
+            "/eth/v1/validator/contribution_and_proofs",
+            self.post_contribution_and_proofs,
+        )
+        r.add_post(
+            "/eth/v1/validator/sync_committee_subscriptions",
+            self.post_sync_committee_subscriptions,
+        )
+        r.add_post("/eth/v1/beacon/pool/sync_committees", self.post_pool_sync_committees)
         # light client (beacon/routes/lightclient.ts)
         r.add_get(
             "/eth/v1/beacon/light_client/bootstrap/{block_root}",
@@ -291,7 +323,7 @@ class BeaconRestApiServer:
         blk = self._resolve_block(request.match_info["block_id"])
         if blk is None:
             return _err(404, "block not found")
-        root = ssz.phase0.BeaconBlock.hash_tree_root(blk.message)
+        root = type(blk.message).hash_tree_root(blk.message)
         return _ok({"root": "0x" + root.hex()})
 
     async def get_header(self, request):
@@ -299,7 +331,7 @@ class BeaconRestApiServer:
         if blk is None:
             return _err(404, "block not found")
         m = blk.message
-        root = ssz.phase0.BeaconBlock.hash_tree_root(m)
+        root = type(m).hash_tree_root(m)
         body_t = type(m)._fields_["body"]
         header = ssz.phase0.BeaconBlockHeader(
             slot=m.slot,
@@ -551,8 +583,13 @@ class BeaconRestApiServer:
         )
         graffiti = request.query.get("graffiti", "")
         block = await self._produce_block(slot, randao_reveal, graffiti)
+        from lodestar_tpu.types import fork_of_block
+
+        fork = fork_of_block(block)
         return _ok(
-            to_json(ssz.phase0.BeaconBlock, block), version="phase0", execution_payload_blinded=False
+            to_json(type(block), block),
+            version=fork.value,
+            execution_payload_blinded=False,
         )
 
     async def _produce_block(self, slot, randao_reveal, graffiti=""):
@@ -612,7 +649,10 @@ class BeaconRestApiServer:
                 from lodestar_tpu.execution.engine import build_dev_payload
 
                 body.execution_payload = build_dev_payload(
-                    self.chain.cfg, pre.state
+                    self.chain.cfg, pre.state,
+                    fee_recipient=self.fee_recipients.get(
+                        proposer, b"\x00" * 20
+                    ),
                 )
         hdr = head_state.state.latest_block_header
         parent_hdr = ssz.phase0.BeaconBlockHeader(
@@ -636,6 +676,126 @@ class BeaconRestApiServer:
         )
         block.state_root = post.hash_tree_root()
         return block
+
+    async def produce_blinded_block(self, request):
+        """produceBlindedBlock (routes/validator.ts:168): a block whose body
+        commits to an ExecutionPayloadHeader.  With a builder configured the
+        header is the builder's bid (getHeader); otherwise the locally-built
+        payload is blinded — HTR(header) == HTR(payload) by SSZ design, so
+        the full-block state_root carries over unchanged."""
+        from lodestar_tpu.state_transition import state_transition
+        from lodestar_tpu.types import blinded_types_for, fork_of_block, types_for
+
+        slot = int(request.match_info["slot"])
+        randao_reveal = bytes.fromhex(
+            request.query.get("randao_reveal", "0x" + "00" * 96)[2:]
+        )
+        graffiti = request.query.get("graffiti", "")
+        full = await self._produce_block(slot, randao_reveal, graffiti)
+        fork = fork_of_block(full)
+        try:
+            blinded_block_t, blinded_signed_t, blinded_body_t = blinded_types_for(fork)
+        except KeyError:
+            return _err(400, f"{fork.value} has no blinded block flow")
+        mod = getattr(ssz, fork.value)
+        body_kwargs = {}
+        for n in blinded_body_t._fields_:
+            if n == "execution_payload_header":
+                continue
+            body_kwargs[n] = getattr(full.body, n)
+        header = mod.payload_to_header(full.body.execution_payload)
+        state_root = bytes(full.state_root)
+        if self.builder is not None:
+            st = self.chain.get_head_state()
+            parent_hash = bytes(st.state.latest_execution_payload_header.block_hash)
+            pubkey = bytes(st.state.validators[full.proposer_index].pubkey)
+            try:
+                bid = await self.builder.get_header(slot, parent_hash, pubkey)
+                header = bid.message.header
+            except Exception as e:
+                return _err(502, f"builder getHeader failed: {e}")
+            # builder payload differs from the local one: re-run the
+            # (blinded) STF to get the right post-state root
+            trial_body = blinded_body_t(
+                execution_payload_header=header, **body_kwargs
+            )
+            trial = blinded_signed_t(
+                message=blinded_block_t(
+                    slot=full.slot,
+                    proposer_index=full.proposer_index,
+                    parent_root=bytes(full.parent_root),
+                    state_root=b"\x00" * 32,
+                    body=trial_body,
+                ),
+                signature=b"\x00" * 96,
+            )
+            post = state_transition(
+                self.chain.get_head_state(), trial,
+                verify_state_root=False, verify_proposer=False,
+                verify_signatures=False,
+            )
+            state_root = post.hash_tree_root()
+        blinded = blinded_block_t(
+            slot=full.slot,
+            proposer_index=full.proposer_index,
+            parent_root=bytes(full.parent_root),
+            state_root=state_root,
+            body=blinded_body_t(execution_payload_header=header, **body_kwargs),
+        )
+        return _ok(
+            to_json(blinded_block_t, blinded),
+            version=fork.value,
+            execution_payload_blinded=True,
+        )
+
+    async def post_blinded_block(self, request):
+        """publishBlindedBlock: unblind via the builder (submitBlindedBlock
+        reveals the payload), reassemble the full signed block — same
+        signature, since blinded and full blocks share their signing root —
+        and import+gossip it (reference publishBlindedBlock)."""
+        from lodestar_tpu.types import blinded_types_for, signed_block_wire_codec, types_for
+
+        body = await request.json()
+        slot = int(body["message"]["slot"])
+        fork = signed_block_wire_codec.fork_at_slot(slot)
+        try:
+            _, blinded_signed_t, _ = blinded_types_for(fork)
+        except KeyError:
+            return _err(400, f"{fork.value} has no blinded block flow")
+        signed = from_json(blinded_signed_t, body)
+        if self.builder is None:
+            return _err(400, "no builder configured to unblind")
+        try:
+            payload = await self.builder.submit_blinded_block(signed)
+        except Exception as e:
+            return _err(502, f"builder submitBlindedBlock failed: {e}")
+        if bytes(payload.block_hash) != bytes(
+            signed.message.body.execution_payload_header.block_hash
+        ):
+            return _err(400, "builder revealed a different payload")
+        _, block_t, signed_t, body_t = types_for(fork)
+        body_kwargs = {
+            n: getattr(signed.message.body, n)
+            for n in body_t._fields_
+            if n != "execution_payload"
+        }
+        full = signed_t(
+            message=block_t(
+                slot=signed.message.slot,
+                proposer_index=signed.message.proposer_index,
+                parent_root=bytes(signed.message.parent_root),
+                state_root=bytes(signed.message.state_root),
+                body=body_t(execution_payload=payload, **body_kwargs),
+            ),
+            signature=bytes(signed.signature),
+        )
+        try:
+            await self.chain.process_block(full)
+        except ValueError as e:
+            return _err(400, str(e))
+        if self.network is not None:
+            await self.network.publish_block(full)
+        return web.json_response({}, status=200)
 
     async def produce_attestation_data(self, request):
         slot = int(request.query["slot"])
@@ -713,6 +873,155 @@ class BeaconRestApiServer:
             except (TypeError, KeyError, ValueError) as e:
                 return _err(400, f"bad subscription item: {e!r}")
             svc.add_committee_subscriptions(subs)
+        return web.json_response({}, status=200)
+
+    # ------------------------------------------------------------------
+    # sync-committee validator flow (the reference's
+    # api/src/beacon/routes/validator.ts:245-249 + impl/validator/index.ts
+    # getSyncCommitteeDuties / produceSyncCommitteeContribution /
+    # publishContributionAndProofs / prepareSyncCommitteeSubnets, and the
+    # beacon pool route submitPoolSyncCommitteeSignatures)
+    # ------------------------------------------------------------------
+
+    def _sync_committee_for_epoch(self, st, epoch: int):
+        """current or next committee by sync-committee period of `epoch`
+        relative to the state's period (spec: compute_sync_committee_period)."""
+        per = _p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        state_period = st.state.slot // _p.SLOTS_PER_EPOCH // per
+        period = epoch // per
+        if period == state_period:
+            return st.state.current_sync_committee
+        if period == state_period + 1:
+            return st.state.next_sync_committee
+        return None
+
+    async def post_sync_duties(self, request):
+        epoch = int(request.match_info["epoch"])
+        indices = [int(i) for i in await request.json()]
+        st = self.chain.get_head_state()
+        if not hasattr(st.state, "current_sync_committee"):
+            return _err(400, "pre-altair state has no sync committees")
+        committee = self._sync_committee_for_epoch(st, epoch)
+        if committee is None:
+            return _err(400, f"epoch {epoch} outside current+next sync periods")
+        by_pubkey = {}
+        for pos, pk in enumerate(committee.pubkeys):
+            by_pubkey.setdefault(bytes(pk), []).append(pos)
+        duties = []
+        for vi in indices:
+            if vi >= len(st.state.validators):
+                continue
+            pk = bytes(st.state.validators[vi].pubkey)
+            positions = by_pubkey.get(pk)
+            if positions:
+                duties.append(
+                    {
+                        "pubkey": "0x" + pk.hex(),
+                        "validator_index": str(vi),
+                        "validator_sync_committee_indices": [
+                            str(p) for p in positions
+                        ],
+                    }
+                )
+        return _ok(duties, execution_optimistic=False)
+
+    async def post_prepare_beacon_proposer(self, request):
+        """prepareBeaconProposer (routes/validator.ts prepareBeaconProposer):
+        fee-recipient registrations consumed by local payload production."""
+        body = await request.json()
+        try:
+            for item in body:
+                vi = int(item["validator_index"])
+                fr = bytes.fromhex(item["fee_recipient"].removeprefix("0x"))
+                if len(fr) != 20:
+                    return _err(400, "fee_recipient must be 20 bytes")
+                self.fee_recipients[vi] = fr
+        except (TypeError, KeyError, ValueError) as e:
+            return _err(400, f"bad prepare_beacon_proposer item: {e!r}")
+        return web.json_response({}, status=200)
+
+    async def post_pool_sync_committees(self, request):
+        from lodestar_tpu.chain.validation import (
+            GossipValidationError,
+            validate_sync_committee_message,
+        )
+        from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_SIZE
+
+        body = await request.json()
+        for item in body:
+            message = from_json(ssz.altair.SyncCommitteeMessage, item)
+            st = self.chain.get_head_state()
+            if message.validator_index >= len(st.state.validators):
+                return _err(400, "unknown validator index")
+            positions = [
+                i
+                for i, cpk in enumerate(st.state.current_sync_committee.pubkeys)
+                if bytes(cpk)
+                == bytes(st.state.validators[message.validator_index].pubkey)
+            ]
+            if not positions:
+                return _err(400, "validator not in current sync committee")
+            subnets = {p // SYNC_COMMITTEE_SUBNET_SIZE for p in positions}
+            for subnet in subnets:
+                try:
+                    sub_positions = await validate_sync_committee_message(
+                        self.chain, message, subnet
+                    )
+                except GossipValidationError as e:
+                    return _err(400, f"invalid sync committee message: {e}")
+                for p in sub_positions:
+                    self.chain.sync_committee_message_pool.add(subnet, p, message)
+                if self.network is not None:
+                    await self.network.publish_sync_committee_message(message, subnet)
+        return web.json_response({}, status=200)
+
+    async def get_sync_committee_contribution(self, request):
+        slot = int(request.query["slot"])
+        subcommittee_index = int(request.query["subcommittee_index"])
+        root = bytes.fromhex(request.query["beacon_block_root"].removeprefix("0x"))
+        contribution = self.chain.sync_committee_message_pool.get_contribution(
+            slot, root, subcommittee_index
+        )
+        if contribution is None:
+            return _err(404, "no contribution available")
+        return _ok(to_json(ssz.altair.SyncCommitteeContribution, contribution))
+
+    async def post_contribution_and_proofs(self, request):
+        from lodestar_tpu.chain.validation import (
+            GossipValidationError,
+            validate_sync_committee_contribution,
+        )
+
+        body = await request.json()
+        for item in body:
+            signed = from_json(ssz.altair.SignedContributionAndProof, item)
+            try:
+                await validate_sync_committee_contribution(self.chain, signed)
+            except GossipValidationError as e:
+                return _err(400, f"invalid contribution: {e}")
+            self.chain.sync_contribution_pool.add(signed.message.contribution)
+            if self.network is not None:
+                await self.network.publish_sync_contribution(signed)
+        return web.json_response({}, status=200)
+
+    async def post_sync_committee_subscriptions(self, request):
+        """prepareSyncCommitteeSubnets: mesh the syncnet subnets for the
+        requested validators ahead of their duties."""
+        body = await request.json()
+        svc = getattr(self.network, "syncnets_service", None) if self.network else None
+        if svc is not None:
+            st = self.chain.get_head_state()
+            positions = []
+            for item in body:
+                try:
+                    vi = int(item["validator_index"])
+                    idxs = [int(i) for i in item["sync_committee_indices"]]
+                except (TypeError, KeyError, ValueError) as e:
+                    return _err(400, f"bad subscription item: {e!r}")
+                if vi >= len(st.state.validators):
+                    continue
+                positions.extend(idxs)
+            svc.subscribe_for_positions(positions)
         return web.json_response({}, status=200)
 
     # ------------------------------------------------------------------

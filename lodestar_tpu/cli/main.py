@@ -554,7 +554,11 @@ def run_validator(args) -> int:
         genesis0 = await api.get_genesis()
         gvr = bytes.fromhex(genesis0["genesis_validators_root"][2:])
         store = ValidatorStore(sks, ForkConfig(cfg), gvr)
-        v = Validator(api, store)
+        from lodestar_tpu.validator.chain_header_tracker import ChainHeaderTracker
+
+        tracker = ChainHeaderTracker(args.beacon_url)
+        await tracker.start()
+        v = Validator(api, store, header_tracker=tracker)
         await v.initialize()
         print(
             f"validator client: {len(sks)} keys -> {args.beacon_url}", flush=True
@@ -574,10 +578,13 @@ def run_validator(args) -> int:
                         "proposed": v.produced_blocks,
                         "attested": v.produced_attestations,
                         "aggregated": v.produced_aggregates,
+                        "sync_messages": v.produced_sync_messages,
+                        "sync_contributions": v.produced_sync_contributions,
                     }
                 ),
                 flush=True,
             )
+        await tracker.stop()
 
     asyncio.run(run())
     return 0

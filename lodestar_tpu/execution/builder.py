@@ -68,10 +68,16 @@ class HttpBuilderApi:
 
 class MockBuilder:
     """In-process builder double: bids with a payload built by the mock EL
-    builder and reveals it on submission."""
+    builder and reveals it on submission.
 
-    def __init__(self, value: int = 1_000_000):
+    With a `chain` reference (the dev/test configuration) the bid payload
+    is built against the head state advanced to the bid slot, so it passes
+    every process_execution_payload consistency check — the same service
+    the reference gets from mock-builder/mergemock."""
+
+    def __init__(self, value: int = 1_000_000, chain=None):
         self.value = value
+        self.chain = chain
         self.registrations: Dict[bytes, object] = {}
         self._payloads: Dict[bytes, object] = {}
 
@@ -83,24 +89,42 @@ class MockBuilder:
             self.registrations[bytes(r.message.pubkey)] = r.message
 
     async def get_header(self, slot: int, parent_hash: bytes, pubkey: bytes):
-        from .engine import build_payload
+        from .engine import build_dev_payload, build_payload
 
         reg = self.registrations.get(bytes(pubkey))
         fee_recipient = bytes(reg.fee_recipient) if reg else b"\x00" * 20
-        payload = build_payload(
-            ForkName.bellatrix,
-            parent_hash=parent_hash,
-            timestamp=slot,
-            prev_randao=b"\x00" * 32,
-            fee_recipient=fee_recipient,
-            block_number=slot,
-        )
-        header = ssz.bellatrix.payload_to_header(payload)
+        if self.chain is not None:
+            from lodestar_tpu.state_transition import process_slots
+            from lodestar_tpu.types import fork_of_state
+
+            st = self.chain.get_head_state().clone()
+            if st.state.slot < slot:
+                process_slots(st, slot)
+            payload = build_dev_payload(
+                self.chain.cfg, st.state, fee_recipient=fee_recipient
+            )
+            fork = fork_of_state(st.state)
+        else:
+            payload = build_payload(
+                ForkName.bellatrix,
+                parent_hash=parent_hash,
+                timestamp=slot,
+                prev_randao=b"\x00" * 32,
+                fee_recipient=fee_recipient,
+                block_number=slot,
+            )
+            fork = ForkName.bellatrix
+        mod = getattr(ssz, fork.value)
+        header = mod.payload_to_header(payload)
         self._payloads[bytes(payload.block_hash)] = payload
-        bid = ssz.bellatrix.BuilderBid(
+        # fork-matched bid container so the header field's declared SSZ
+        # type matches its value (serialize/HTR would otherwise use the
+        # wrong layout); eip4844 reuses capella's bid shape here
+        bid_mod = mod if hasattr(mod, "BuilderBid") else ssz.capella
+        bid = bid_mod.BuilderBid(
             header=header, value=self.value, pubkey=b"\xaa" * 48
         )
-        return ssz.bellatrix.SignedBuilderBid(message=bid, signature=b"\x00" * 96)
+        return bid_mod.SignedBuilderBid(message=bid, signature=b"\x00" * 96)
 
     async def submit_blinded_block(self, signed_blinded_block):
         h = bytes(

@@ -80,7 +80,7 @@ def build_payload(
     return payload
 
 
-def build_dev_payload(cfg, state, transactions=()):
+def build_dev_payload(cfg, state, transactions=(), fee_recipient=b"\x00" * 20):
     """Payload valid for the next block on `state` (already advanced to the
     block's slot): satisfies every process_execution_payload consistency
     check (parent_hash / prev_randao / timestamp)."""
@@ -107,6 +107,7 @@ def build_dev_payload(cfg, state, transactions=()):
         withdrawals=withdrawals,
         block_number=state.latest_execution_payload_header.block_number + 1,
         transactions=transactions,
+        fee_recipient=fee_recipient,
     )
 
 

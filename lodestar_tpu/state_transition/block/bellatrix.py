@@ -23,11 +23,24 @@ def is_merge_transition_complete(state) -> bool:
     return state.latest_execution_payload_header != header_t.default()
 
 
+def _body_payload_or_header(body):
+    """(value, is_blinded) — blinded bodies carry execution_payload_header
+    (spec process_execution_payload(header) for blinded blocks)."""
+    if hasattr(body, "execution_payload"):
+        return body.execution_payload, False
+    return body.execution_payload_header, True
+
+
 def is_merge_transition_block(state, body) -> bool:
-    payload_t = type(body)._fields_["execution_payload"]
+    field = (
+        "execution_payload"
+        if hasattr(body, "execution_payload")
+        else "execution_payload_header"
+    )
+    payload_t = type(body)._fields_[field]
     return (
         not is_merge_transition_complete(state)
-        and body.execution_payload != payload_t.default()
+        and getattr(body, field) != payload_t.default()
     )
 
 
@@ -47,7 +60,7 @@ def process_execution_payload(cfg, state, body, execution_engine=None) -> None:
     capella+ assert it unconditionally (capella/beacon-chain.md)."""
     from lodestar_tpu.params import FORK_SEQ
 
-    payload = body.execution_payload
+    payload, blinded = _body_payload_or_header(body)
     fork = fork_of_state(state)
     post_capella = FORK_SEQ[fork] >= FORK_SEQ[ForkName.capella]
     if post_capella or is_merge_transition_complete(state):
@@ -60,12 +73,17 @@ def process_execution_payload(cfg, state, body, execution_engine=None) -> None:
         raise ValueError("execution payload prev_randao mismatch")
     if payload.timestamp != compute_timestamp_at_slot(cfg, state, state.slot):
         raise ValueError("execution payload timestamp mismatch")
+    if blinded:
+        # blinded STF (spec process_execution_payload over the header):
+        # the committed header IS the state's new latest header; the full
+        # payload is revealed out-of-band by the builder on submission
+        state.latest_execution_payload_header = payload.copy()
+        return
     if execution_engine is not None:
         if not execution_engine.notify_new_payload_sync(payload):
             raise ValueError("execution engine rejected payload")
     # fork-matched header conversion (bellatrix/capella/eip4844 modules each
     # export payload_to_header for their payload shape)
-    fork = fork_of_state(state)
     mod = getattr(ssz, fork.value)
     state.latest_execution_payload_header = mod.payload_to_header(payload)
 

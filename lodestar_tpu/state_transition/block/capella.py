@@ -83,15 +83,23 @@ def get_expected_withdrawals(state) -> List:
 
 
 def process_withdrawals(cfg, state, payload) -> None:
+    """Full payloads compare withdrawal-by-withdrawal; blinded headers
+    compare the committed withdrawals_root (spec blinded process_withdrawals)."""
     expected = get_expected_withdrawals(state)
-    got = list(payload.withdrawals)
-    if len(got) != len(expected):
-        raise ValueError(
-            f"withdrawals count mismatch: payload {len(got)} != expected {len(expected)}"
-        )
-    for w, e in zip(got, expected):
-        if w != e:
-            raise ValueError("withdrawal mismatch")
+    if hasattr(payload, "withdrawals"):
+        got = list(payload.withdrawals)
+        if len(got) != len(expected):
+            raise ValueError(
+                f"withdrawals count mismatch: payload {len(got)} != expected {len(expected)}"
+            )
+        for w, e in zip(got, expected):
+            if w != e:
+                raise ValueError("withdrawal mismatch")
+    else:
+        wl_t = ssz.capella.ExecutionPayload._fields_["withdrawals"]
+        if bytes(payload.withdrawals_root) != wl_t.hash_tree_root(expected):
+            raise ValueError("blinded withdrawals_root mismatch")
+    for w in expected:
         decrease_balance(state, w.validator_index, w.amount)
     if expected:
         state.next_withdrawal_index = expected[-1].index + 1
@@ -193,7 +201,7 @@ def process_block(
 ) -> None:
     b0.process_block_header(cfg, state, epoch_ctx, block)
     if bm.is_execution_enabled(state, block.body):
-        process_withdrawals(cfg, state, block.body.execution_payload)
+        process_withdrawals(cfg, state, bm._body_payload_or_header(block.body)[0])
         bm.process_execution_payload(cfg, state, block.body, execution_engine)
     b0.process_randao(cfg, state, epoch_ctx, block.body, verify_signatures)
     b0.process_eth1_data(cfg, state, block.body)

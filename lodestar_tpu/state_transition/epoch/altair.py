@@ -140,14 +140,16 @@ def process_inactivity_updates(cfg, state, proc: AltairEpochProcess) -> None:
         state.inactivity_scores[int(i)] = int(scores[i])
 
 
-def get_flag_deltas(cfg, state, proc: AltairEpochProcess):
-    """Vectorized altair get_flag_index_deltas + inactivity penalties."""
+def get_flag_index_deltas(cfg, state, proc: AltairEpochProcess, flag_index: int):
+    """Per-flag (rewards, penalties) arrays — spec get_flag_index_deltas.
+    Exposed separately so the rewards conformance runner
+    (spec_test/runners.py make_rewards_runner) can emit the official
+    per-component Deltas files."""
+    import math
+
     n = len(proc.effective_balances)
     rewards = np.zeros(n, dtype=np.int64)
     penalties = np.zeros(n, dtype=np.int64)
-
-    import math
-
     increment = _p.EFFECTIVE_BALANCE_INCREMENT
     base_reward_per_increment = (
         increment * _p.BASE_REWARD_FACTOR // math.isqrt(proc.total_active_balance)
@@ -155,28 +157,32 @@ def get_flag_deltas(cfg, state, proc: AltairEpochProcess):
     base_rewards = (proc.effective_balances // increment) * base_reward_per_increment
     total_incr = proc.total_active_balance // increment
     leaking = is_in_inactivity_leak(proc, state)
+    weight = PARTICIPATION_FLAG_WEIGHTS[flag_index]
 
-    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
-        participating = (
-            proc.unslashed
-            & proc.is_active_prev
-            & ((proc.prev_participation & (1 << flag_index)) != 0)
-        )
-        unslashed_incr = (
-            max(increment, int(proc.effective_balances[participating].sum()))
-            // increment
-        )
-        mask_r = proc.eligible & participating
-        mask_p = proc.eligible & ~participating
-        if not leaking:
-            reward_numerator = (
-                base_rewards[mask_r] * weight * unslashed_incr
-            )
-            rewards[mask_r] += reward_numerator // (total_incr * WEIGHT_DENOMINATOR)
-        if flag_index != TIMELY_HEAD_FLAG_INDEX:
-            penalties[mask_p] += base_rewards[mask_p] * weight // WEIGHT_DENOMINATOR
+    participating = (
+        proc.unslashed
+        & proc.is_active_prev
+        & ((proc.prev_participation & (1 << flag_index)) != 0)
+    )
+    unslashed_incr = (
+        max(increment, int(proc.effective_balances[participating].sum()))
+        // increment
+    )
+    mask_r = proc.eligible & participating
+    mask_p = proc.eligible & ~participating
+    if not leaking:
+        reward_numerator = base_rewards[mask_r] * weight * unslashed_incr
+        rewards[mask_r] += reward_numerator // (total_incr * WEIGHT_DENOMINATOR)
+    if flag_index != TIMELY_HEAD_FLAG_INDEX:
+        penalties[mask_p] += base_rewards[mask_p] * weight // WEIGHT_DENOMINATOR
+    return rewards, penalties
 
-    # inactivity penalties (spec get_inactivity_penalty_deltas)
+
+def get_inactivity_penalty_deltas(cfg, state, proc: AltairEpochProcess):
+    """Spec get_inactivity_penalty_deltas (zero rewards by construction)."""
+    n = len(proc.effective_balances)
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
     scores = np.array(state.inactivity_scores, dtype=np.int64)
     prev_target = (
         proc.unslashed
@@ -193,6 +199,21 @@ def get_flag_deltas(cfg, state, proc: AltairEpochProcess):
     penalties[mask] += (
         proc.effective_balances[mask] * scores[mask] // penalty_den
     )
+    return rewards, penalties
+
+
+def get_flag_deltas(cfg, state, proc: AltairEpochProcess):
+    """Vectorized altair get_flag_index_deltas + inactivity penalties."""
+    n = len(proc.effective_balances)
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+    for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS)):
+        r, p = get_flag_index_deltas(cfg, state, proc, flag_index)
+        rewards += r
+        penalties += p
+    r, p = get_inactivity_penalty_deltas(cfg, state, proc)
+    rewards += r
+    penalties += p
     return rewards, penalties
 
 

@@ -1,20 +1,35 @@
-"""Range sync (reference: beacon-node/src/sync/range/range.ts RangeSync +
-sync/sync.ts BeaconSync orchestration, batches of EPOCHS_PER_BATCH=1 epoch,
-retry limits from sync/constants.ts:8-11).
+"""Range sync: SyncChain with a per-batch state machine and pipelined
+download/processing.
+
+Reference behaviors (beacon-node/src/sync/range/chain.ts:80 SyncChain,
+range/batch.ts Batch, sync/constants.ts:8-11 retry ceilings):
+
+- the chain's slot span is cut into EPOCHS_PER_BATCH-epoch batches, each
+  a small state machine (AwaitingDownload -> Downloading ->
+  AwaitingProcessing -> Processing -> done / back to AwaitingDownload on
+  failure) with its own download/processing attempt counters;
+- up to BATCH_BUFFER_SIZE batches download CONCURRENTLY from distinct
+  peers while earlier batches process — one slow peer no longer stalls
+  the pipeline, it just serves a late batch;
+- batches process strictly in slot order;
+- a failed download retries on another peer; an invalid batch penalizes
+  the peer that SERVED it (not the whole segment) and is re-downloaded
+  from a different peer before the chain gives up.
 """
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from enum import Enum
-from typing import List, Optional
+from typing import Dict, List, Optional, Set
 
 from lodestar_tpu.params import ACTIVE_PRESET as _p
 from lodestar_tpu.network.peers import PeerAction
 
 EPOCHS_PER_BATCH = 1  # sync/constants.ts:41
-MAX_BATCH_DOWNLOAD_ATTEMPTS = 5  # sync/constants.ts
-MAX_BATCH_PROCESSING_ATTEMPTS = 3
+MAX_BATCH_DOWNLOAD_ATTEMPTS = 5  # sync/constants.ts:8
+MAX_BATCH_PROCESSING_ATTEMPTS = 3  # sync/constants.ts:11
+BATCH_BUFFER_SIZE = 5  # concurrent in-flight batches (chain.ts batchBuffer)
 
 
 class SyncState(str, Enum):
@@ -22,6 +37,29 @@ class SyncState(str, Enum):
     SyncingFinalized = "SyncingFinalized"
     SyncingHead = "SyncingHead"
     Synced = "Synced"
+
+
+class BatchStatus(str, Enum):
+    AwaitingDownload = "AwaitingDownload"
+    Downloading = "Downloading"
+    AwaitingProcessing = "AwaitingProcessing"
+    Processing = "Processing"
+    Done = "Done"
+    Failed = "Failed"
+
+
+@dataclass
+class Batch:
+    """One EPOCHS_PER_BATCH span and its retry bookkeeping (batch.ts)."""
+
+    start_slot: int
+    count: int
+    status: BatchStatus = BatchStatus.AwaitingDownload
+    blocks: List = field(default_factory=list)
+    serving_peer: Optional[str] = None
+    failed_peers: Set[str] = field(default_factory=set)
+    download_attempts: int = 0
+    processing_attempts: int = 0
 
 
 @dataclass
@@ -32,12 +70,14 @@ class SyncResult:
 
 
 class RangeSync:
-    """Pull batches from best peers and drive them through the chain's
-    block pipeline until caught up with the peers' head."""
+    """SyncChain driver: concurrent batch downloads across peers, strictly
+    ordered processing through the chain's block pipeline."""
 
-    def __init__(self, network, chain):
+    def __init__(self, network, chain, batch_buffer: int = BATCH_BUFFER_SIZE):
         self.network = network
         self.chain = chain
+        self.batch_buffer = batch_buffer
+        self.imported = 0
 
     def _target_slot(self) -> int:
         best = 0
@@ -47,40 +87,148 @@ class RangeSync:
                 best = max(best, info.status.head_slot)
         return best
 
-    async def sync(self) -> SyncResult:
-        imported = 0
-        batch_slots = EPOCHS_PER_BATCH * _p.SLOTS_PER_EPOCH
-        while True:
-            head_slot = self.chain.fork_choice.get_head().slot
-            target = self._target_slot()
-            if head_slot >= target:
-                return SyncResult(imported, head_slot, SyncState.Synced)
-            start = head_slot + 1
-            count = min(batch_slots, target - head_slot)
-            blocks = await self._download_batch(start, count)
-            if not blocks:
-                return SyncResult(imported, head_slot, SyncState.Stalled)
-            for block in blocks:
-                try:
-                    await self.chain.process_block(block)
-                    imported += 1
-                except ValueError:
-                    # invalid segment: penalize the serving peers and stop
-                    for pid in self.network.peer_manager.best_peers(start):
-                        self.network.peer_manager.scores.apply_action(
-                            pid, PeerAction.MidToleranceError
-                        )
-                    return SyncResult(imported, head_slot, SyncState.Stalled)
-
-    async def _download_batch(self, start: int, count: int) -> Optional[List]:
-        peers = self.network.peer_manager.best_peers(min_head_slot=start)
-        attempts = 0
-        for pid in peers * MAX_BATCH_DOWNLOAD_ATTEMPTS:
-            if attempts >= MAX_BATCH_DOWNLOAD_ATTEMPTS:
-                break
-            attempts += 1
-            try:
-                return await self.network.blocks_by_range(pid, start, count)
-            except Exception:
-                continue
+    def _pick_peer(self, batch: Batch, busy: Set[str]) -> Optional[str]:
+        """Best peer that can serve the batch, avoiding peers that already
+        failed it and peers currently serving another batch (load spread)."""
+        peers = self.network.peer_manager.best_peers(
+            min_head_slot=batch.start_slot
+        )
+        for pid in peers:
+            if pid not in batch.failed_peers and pid not in busy:
+                return pid
+        for pid in peers:  # all idle peers failed it: allow busy ones
+            if pid not in batch.failed_peers:
+                return pid
         return None
+
+    async def _download(self, batch: Batch, pid: str) -> None:
+        batch.status = BatchStatus.Downloading
+        batch.serving_peer = pid
+        batch.download_attempts += 1
+        try:
+            blocks = await self.network.blocks_by_range(
+                pid, batch.start_slot, batch.count
+            )
+        except Exception:
+            blocks = None
+        if blocks is None:
+            batch.failed_peers.add(pid)
+            self.network.peer_manager.scores.apply_action(
+                pid, PeerAction.LowToleranceError
+            )
+            batch.status = (
+                BatchStatus.AwaitingDownload
+                if batch.download_attempts < MAX_BATCH_DOWNLOAD_ATTEMPTS
+                else BatchStatus.Failed
+            )
+            return
+        batch.blocks = blocks
+        batch.status = BatchStatus.AwaitingProcessing
+
+    async def _process(self, batch: Batch) -> bool:
+        """Import the batch's blocks in order; on an invalid block penalize
+        the serving peer and send the batch back for re-download from a
+        different peer (batch.ts processing failure path)."""
+        batch.status = BatchStatus.Processing
+        try:
+            for block in batch.blocks:
+                await self.chain.process_block(block)
+                self.imported += 1
+        except ValueError:
+            batch.processing_attempts += 1
+            if batch.serving_peer is not None:
+                batch.failed_peers.add(batch.serving_peer)
+                self.network.peer_manager.scores.apply_action(
+                    batch.serving_peer, PeerAction.MidToleranceError
+                )
+            batch.blocks = []
+            batch.status = (
+                BatchStatus.AwaitingDownload
+                if batch.processing_attempts < MAX_BATCH_PROCESSING_ATTEMPTS
+                else BatchStatus.Failed
+            )
+            return False
+        batch.status = BatchStatus.Done
+        return True
+
+    async def sync(self) -> SyncResult:
+        batch_slots = EPOCHS_PER_BATCH * _p.SLOTS_PER_EPOCH
+        batches: Dict[int, Batch] = {}  # start_slot -> Batch
+        tasks: Dict[int, asyncio.Task] = {}
+        next_start = self.chain.fork_choice.get_head().slot + 1
+
+        try:
+            while True:
+                head_slot = self.chain.fork_choice.get_head().slot
+                target = self._target_slot()
+                if head_slot >= target and not batches:
+                    return SyncResult(self.imported, head_slot, SyncState.Synced)
+
+                # extend the batch window up to the buffer size
+                while len(batches) < self.batch_buffer and next_start <= target:
+                    count = min(batch_slots, target - next_start + 1)
+                    batches[next_start] = Batch(start_slot=next_start, count=count)
+                    next_start += count
+
+                if not batches:
+                    # window drained: Synced only if the head actually
+                    # reached the peers' target — peers serving EMPTY
+                    # batches must not fake a successful sync
+                    head_slot = self.chain.fork_choice.get_head().slot
+                    return SyncResult(
+                        self.imported,
+                        head_slot,
+                        SyncState.Synced if head_slot >= target else SyncState.Stalled,
+                    )
+
+                # any batch out of retries kills the chain (chain.ts
+                # ChainErrorType.MAX_DOWNLOAD/PROCESSING_ATTEMPTS)
+                if any(b.status is BatchStatus.Failed for b in batches.values()):
+                    return SyncResult(self.imported, head_slot, SyncState.Stalled)
+
+                # launch downloads for idle batches on distinct peers
+                busy = {
+                    b.serving_peer
+                    for b in batches.values()
+                    if b.status is BatchStatus.Downloading and b.serving_peer
+                }
+                launched = False
+                for start in sorted(batches):
+                    b = batches[start]
+                    if b.status is not BatchStatus.AwaitingDownload:
+                        continue
+                    pid = self._pick_peer(b, busy)
+                    if pid is None:
+                        continue
+                    busy.add(pid)
+                    tasks[start] = asyncio.create_task(self._download(b, pid))
+                    launched = True
+
+                # process the LOWEST batch if ready (strict order) — while
+                # it imports, the download tasks keep running concurrently
+                lowest = min(batches)
+                lb = batches[lowest]
+                if lb.status is BatchStatus.AwaitingProcessing:
+                    ok = await self._process(lb)
+                    if ok:
+                        tasks.pop(lowest, None)
+                        del batches[lowest]
+                    continue
+
+                # nothing processable: wait for a download to finish
+                pending = [t for t in tasks.values() if not t.done()]
+                if pending:
+                    await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED
+                    )
+                elif not launched:
+                    # no peers can serve the remaining batches
+                    return SyncResult(
+                        self.imported,
+                        self.chain.fork_choice.get_head().slot,
+                        SyncState.Stalled,
+                    )
+        finally:
+            for t in tasks.values():
+                if not t.done():
+                    t.cancel()

@@ -56,6 +56,29 @@ _BODY_TYPES = {
     ForkName.capella: capella.BeaconBlockBody,
     ForkName.eip4844: eip4844.BeaconBlockBody,
 }
+# blinded (builder-flow) variants, bellatrix+ (reference allForksBlinded)
+_BLINDED_TYPES = {
+    ForkName.bellatrix: (
+        bellatrix.BlindedBeaconBlock,
+        bellatrix.SignedBlindedBeaconBlock,
+        bellatrix.BlindedBeaconBlockBody,
+    ),
+    ForkName.capella: (
+        capella.BlindedBeaconBlock,
+        capella.SignedBlindedBeaconBlock,
+        capella.BlindedBeaconBlockBody,
+    ),
+    ForkName.eip4844: (
+        eip4844.BlindedBeaconBlock,
+        eip4844.SignedBlindedBeaconBlock,
+        eip4844.BlindedBeaconBlockBody,
+    ),
+}
+
+
+def blinded_types_for(fork: ForkName):
+    """(BlindedBeaconBlock, SignedBlindedBeaconBlock, BlindedBeaconBlockBody)."""
+    return _BLINDED_TYPES[fork]
 
 
 # era-schema variants: fixture/devnet-era containers (e.g. pre-
@@ -86,6 +109,9 @@ def fork_of_block(block) -> ForkName:
             return fork
     for fork, t in _SIGNED_BLOCK_TYPES.items():
         if isinstance(block, t):
+            return fork
+    for fork, (bt, st, _) in _BLINDED_TYPES.items():
+        if isinstance(block, (bt, st)):
             return fork
     raise TypeError(f"unknown block type {type(block)!r}")
 

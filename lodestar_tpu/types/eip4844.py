@@ -166,6 +166,17 @@ class SignedBlindedBeaconBlock(Container):
     signature: phase0.BLSSignature
 
 
+class BuilderBid(Container):
+    header: ExecutionPayloadHeader
+    value: uint256
+    pubkey: phase0.BLSPubkey
+
+
+class SignedBuilderBid(Container):
+    message: BuilderBid
+    signature: phase0.BLSSignature
+
+
 class BeaconState(Container):
     genesis_time: uint64
     genesis_validators_root: phase0.Root

@@ -1,0 +1,57 @@
+"""VC-side chain head tracker: follows the node's `head` SSE events.
+
+Reference: packages/validator/src/services/chainHeaderTracker.ts — the VC
+keeps the latest head (slot, root) pushed by the beacon node's event
+stream instead of polling, and duty services read it synchronously.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+
+class ChainHeaderTracker:
+    """Background task consuming /eth/v1/events?topics=head."""
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+        self.head_slot: Optional[int] = None
+        self.head_root: Optional[bytes] = None
+        self._task: Optional[asyncio.Task] = None
+        self._session = None
+
+    async def start(self) -> None:
+        import aiohttp
+
+        self._session = aiohttp.ClientSession()
+        self._task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        try:
+            async with self._session.get(
+                self.base_url + "/eth/v1/events",
+                params={"topics": "head"},
+                timeout=None,
+            ) as resp:
+                event = None
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if line.startswith("event:"):
+                        event = line.split(":", 1)[1].strip()
+                    elif line.startswith("data:") and event == "head":
+                        data = json.loads(line.split(":", 1)[1])
+                        self.head_slot = int(data["slot"])
+                        self.head_root = bytes.fromhex(data["block"][2:])
+        except (asyncio.CancelledError, Exception):
+            pass  # tracker is best-effort; consumers fall back to polling
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._session is not None:
+            await self._session.close()

@@ -1,0 +1,166 @@
+"""Validator-client sync-committee duty pipeline.
+
+Reference: packages/validator/src/services/syncCommitteeDuties.ts:68
+(duty fetch + subnet subscriptions per period) and syncCommittee.ts:22
+(per-slot message production, then aggregator contribution publication a
+third of a slot later).  Condensed to the same duty math on the rebuild's
+API client:
+
+  every slot, for each duty validator:
+    1. sign the head block root with DOMAIN_SYNC_COMMITTEE and submit to
+       the beacon pool route (node validates + gossips + pools it);
+    2. for each subcommittee the validator sits in, sign the
+       SyncAggregatorSelectionData; if is_sync_committee_aggregator
+       (hash(sig) % MODULUS == 0, util/aggregator.py), fetch the pooled
+       contribution and publish a SignedContributionAndProof.
+
+Duties are refetched per epoch (cheap on the rebuild's in-process API)
+rather than cached per period; subnet subscriptions go out with the
+first fetch of each epoch like prepareSyncCommitteeSubnets.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_SIZE
+from lodestar_tpu.state_transition.util.aggregator import (
+    is_sync_committee_aggregator,
+)
+from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+
+
+@dataclass
+class SyncDuty:
+    pubkey: bytes
+    validator_index: int
+    positions: List[int]  # indices within the full sync committee
+
+    @property
+    def subcommittees(self) -> List[int]:
+        return sorted({p // SYNC_COMMITTEE_SUBNET_SIZE for p in self.positions})
+
+
+@dataclass
+class SyncCommitteeService:
+    """Per-slot sync-committee duties for a key store's validators.
+
+    `index_provider` returns the VC's pubkey->index map (the Validator
+    client's indices service already maintains it — refetching the whole
+    registry per epoch would cost a full-registry round trip at mainnet
+    scale).  `tracker` (optional ChainHeaderTracker) supplies the SSE-
+    pushed head root; duty production falls back to polling when the
+    tracker hasn't caught up to the duty slot."""
+
+    api: "ApiClient"
+    store: "ValidatorStore"
+    index_provider: "Callable[[], Dict[bytes, int]]" = None
+    tracker: "ChainHeaderTracker" = None
+    _duty_cache: Dict[int, List[SyncDuty]] = field(default_factory=dict)
+    _subscribed_epochs: set = field(default_factory=set)
+
+    async def _head_root(self, slot: int) -> bytes:
+        t = self.tracker
+        if (
+            t is not None
+            and t.head_root is not None
+            and t.head_slot is not None
+            and t.head_slot >= slot
+        ):
+            return t.head_root
+        return await self.api.get_block_root("head")
+
+    async def duties(self, epoch: int) -> List[SyncDuty]:
+        if epoch in self._duty_cache:
+            return self._duty_cache[epoch]
+        if self.index_provider is not None:
+            index_of = dict(self.index_provider())
+        else:
+            raw = await self.api.get_validators("head")
+            index_of = {
+                bytes.fromhex(v["validator"]["pubkey"][2:]): int(v["index"])
+                for v in raw
+            }
+        pubkeys = {pk: True for pk in self.store.pubkeys}
+        indices = [index_of[pk] for pk in pubkeys if pk in index_of]
+        duties = []
+        try:
+            items = await self.api.get_sync_duties(epoch, indices)
+        except Exception:
+            items = []  # pre-altair node or route unavailable
+        for item in items:
+            duties.append(
+                SyncDuty(
+                    pubkey=bytes.fromhex(item["pubkey"][2:]),
+                    validator_index=int(item["validator_index"]),
+                    positions=[
+                        int(p) for p in item["validator_sync_committee_indices"]
+                    ],
+                )
+            )
+        self._duty_cache[epoch] = duties
+        for old in [e for e in self._duty_cache if e < epoch - 1]:
+            del self._duty_cache[old]
+        if duties and epoch not in self._subscribed_epochs:
+            self._subscribed_epochs.add(epoch)
+            try:
+                await self.api.prepare_sync_committee_subnets(
+                    [
+                        {
+                            "validator_index": d.validator_index,
+                            "sync_committee_indices": d.positions,
+                            "until_epoch": epoch + 1,
+                        }
+                        for d in duties
+                    ]
+                )
+            except Exception:
+                pass  # transient: retried with the next epoch's fetch
+        return duties
+
+    async def produce_messages(self, slot: int) -> int:
+        """Sign + submit one SyncCommitteeMessage per duty validator over
+        the current head root (syncCommittee.ts produceAndPublishSyncCommittees)."""
+        duties = await self.duties(compute_epoch_at_slot(slot))
+        if not duties:
+            return 0
+        head_root = await self._head_root(slot)
+        messages = [
+            self.store.sign_sync_committee_message(
+                d.pubkey, slot, head_root, d.validator_index
+            )
+            for d in duties
+        ]
+        await self.api.submit_pool_sync_committee_messages(messages)
+        return len(messages)
+
+    async def aggregate_if_due(self, slot: int) -> int:
+        """Selection proofs per (duty, subcommittee); aggregators fetch the
+        pooled contribution and publish SignedContributionAndProof
+        (syncCommittee.ts produceAndPublishAggregates)."""
+        duties = await self.duties(compute_epoch_at_slot(slot))
+        if not duties:
+            return 0
+        head_root = await self._head_root(slot)
+        published = 0
+        signed_batch = []
+        for d in duties:
+            for sub in d.subcommittees:
+                proof = self.store.sign_sync_selection_proof(d.pubkey, slot, sub)
+                if not is_sync_committee_aggregator(proof):
+                    continue
+                try:
+                    contribution = await self.api.produce_sync_committee_contribution(
+                        slot, sub, head_root
+                    )
+                except Exception:
+                    continue  # no messages pooled for this subcommittee
+                signed_batch.append(
+                    self.store.sign_contribution_and_proof(
+                        d.pubkey, contribution, d.validator_index, proof
+                    )
+                )
+        if signed_batch:
+            await self.api.submit_contribution_and_proofs(signed_batch)
+            published = len(signed_batch)
+        return published

@@ -37,15 +37,43 @@ class Validator:
     slot (proposals at slot start, attestations at 1/3 slot, aggregation at
     2/3 slot — here sequential)."""
 
-    def __init__(self, api: ApiClient, store: ValidatorStore):
+    def __init__(
+        self,
+        api: ApiClient,
+        store: ValidatorStore,
+        use_builder: bool = False,
+        fee_recipient: bytes = b"\x00" * 20,
+        header_tracker=None,
+    ):
+        from .sync_committee import SyncCommitteeService
+
         self.api = api
         self.store = store
+        # builder flow (validator --builder; reference's
+        # produceBlindedBlock path, validator.ts:168): propose via
+        # blinded blocks, node unblinds through its builder client
+        self.use_builder = use_builder
+        # prepareBeaconProposer (services/prepareBeaconProposer.ts):
+        # announced once per epoch for every managed key
+        self.fee_recipient = fee_recipient
+        self._prepared_epochs: set = set()
         self._index_by_pubkey: Dict[bytes, int] = {}
         self.produced_blocks = 0
         self.produced_attestations = 0
         self.produced_aggregates = 0
+        self.produced_sync_messages = 0
+        self.produced_sync_contributions = 0
         self._announced_duty_epochs: set = set()
         self._selection_proofs: Dict[tuple, bytes] = {}
+        # optional SSE head tracker (chainHeaderTracker.ts); start() it to
+        # let duty services use the event-pushed head instead of polling
+        self.header_tracker = header_tracker
+        self.sync_committee = SyncCommitteeService(
+            api=api,
+            store=store,
+            index_provider=lambda: self._index_by_pubkey,
+            tracker=header_tracker,
+        )
 
     async def initialize(self) -> None:
         """Map pubkeys to validator indices (validator.ts
@@ -73,11 +101,20 @@ class Validator:
             if not self.store.has(pk):
                 continue
             randao = self.store.sign_randao(pk, slot)
-            block = await self.api.produce_block(slot, randao, graffiti="lodestar-tpu-vc")
-            signed = self.store.sign_block(pk, block)
-            await self.api.publish_block(signed)
+            if self.use_builder:
+                block = await self.api.produce_blinded_block(
+                    slot, randao, graffiti="lodestar-tpu-vc"
+                )
+                signed = self.store.sign_block(pk, block)
+                await self.api.publish_blinded_block(signed)
+            else:
+                block = await self.api.produce_block(
+                    slot, randao, graffiti="lodestar-tpu-vc"
+                )
+                signed = self.store.sign_block(pk, block)
+                await self.api.publish_block(signed)
             self.produced_blocks += 1
-            return ssz.phase0.BeaconBlock.hash_tree_root(block)
+            return type(block).hash_tree_root(block)
         return None
 
     async def attest(self, slot: int) -> List["ssz.phase0.Attestation"]:
@@ -180,7 +217,32 @@ class Validator:
             self._selection_proofs[key] = proof
         return proof
 
+    async def prepare_proposers_if_due(self, slot: int) -> None:
+        """Once per epoch: register fee recipients for all managed keys
+        (prepareBeaconProposer.ts pattern — re-sent each epoch so a
+        restarted node re-learns them)."""
+        epoch = compute_epoch_at_slot(slot)
+        if epoch in self._prepared_epochs or not self._index_by_pubkey:
+            return
+        self._prepared_epochs.add(epoch)
+        try:
+            await self.api.prepare_beacon_proposer(
+                [
+                    {"validator_index": vi, "fee_recipient": self.fee_recipient}
+                    for vi in self.indices
+                ]
+            )
+        except Exception:
+            self._prepared_epochs.discard(epoch)  # transient: retry next slot
+
     async def run_slot(self, slot: int) -> None:
+        await self.prepare_proposers_if_due(slot)
         await self.propose_if_due(slot)
         await self.attest(slot)
         await self.aggregate_if_due(slot)
+        # sync-committee duties (altair+; duties() resolves to [] when the
+        # node has no committees for our keys, making these no-ops)
+        self.produced_sync_messages += await self.sync_committee.produce_messages(slot)
+        self.produced_sync_contributions += await self.sync_committee.aggregate_if_due(
+            slot
+        )

@@ -10,8 +10,11 @@ from lodestar_tpu.params import (
     DOMAIN_AGGREGATE_AND_PROOF,
     DOMAIN_BEACON_ATTESTER,
     DOMAIN_BEACON_PROPOSER,
+    DOMAIN_CONTRIBUTION_AND_PROOF,
     DOMAIN_RANDAO,
     DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
     DOMAIN_VOLUNTARY_EXIT,
 )
 from lodestar_tpu.state_transition.util.domain import (
@@ -69,6 +72,8 @@ class ValidatorStore:
     # signing duties ---------------------------------------------------
 
     def sign_block(self, pubkey: bytes, block) -> "ssz.phase0.SignedBeaconBlock":
+        from lodestar_tpu.types import fork_of_block, types_for
+
         epoch = compute_epoch_at_slot(block.slot)
         domain = self._domain(DOMAIN_BEACON_PROPOSER, epoch)
         block_t = type(block)
@@ -77,7 +82,18 @@ class ValidatorStore:
             pubkey, SignedBlockRecord(slot=block.slot, signing_root=root)
         )
         sig = self._sk(pubkey).sign(root)
-        return ssz.phase0.SignedBeaconBlock(message=block, signature=sig.to_bytes())
+        # fork-aware signed wrapper: a phase0 wrapper would re-serialize an
+        # altair+ message with the phase0 body layout (dropping fields).
+        # Blinded blocks (builder flow) get the blinded wrapper — both
+        # share the same signing root by SSZ design.
+        fork = fork_of_block(block)
+        if hasattr(block.body, "execution_payload_header"):
+            from lodestar_tpu.types import blinded_types_for
+
+            signed_t = blinded_types_for(fork)[1]
+        else:
+            signed_t = types_for(fork)[2]
+        return signed_t(message=block, signature=sig.to_bytes())
 
     def sign_attestation(
         self, pubkey: bytes, data: "ssz.phase0.AttestationData", committee_size: int,
@@ -123,6 +139,55 @@ class ValidatorStore:
         sig = self._sk(pubkey).sign(root)
         return ssz.phase0.SignedAggregateAndProof(
             message=agg_and_proof, signature=sig.to_bytes()
+        )
+
+    def sign_sync_committee_message(
+        self, pubkey: bytes, slot: int, beacon_block_root: bytes, validator_index: int
+    ) -> "ssz.altair.SyncCommitteeMessage":
+        """signSyncCommitteeSignature (validatorStore.ts): BLS over the head
+        block root with DOMAIN_SYNC_COMMITTEE."""
+        epoch = compute_epoch_at_slot(slot)
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE, epoch)
+        root = compute_signing_root(ssz.phase0.Root, beacon_block_root, domain)
+        sig = self._sk(pubkey).sign(root)
+        return ssz.altair.SyncCommitteeMessage(
+            slot=slot,
+            beacon_block_root=beacon_block_root,
+            validator_index=validator_index,
+            signature=sig.to_bytes(),
+        )
+
+    def sign_sync_selection_proof(
+        self, pubkey: bytes, slot: int, subcommittee_index: int
+    ) -> bytes:
+        """signSyncCommitteeSelectionProof: over SyncAggregatorSelectionData;
+        is_sync_committee_aggregator(hash) decides aggregation duty."""
+        epoch = compute_epoch_at_slot(slot)
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch)
+        data = ssz.altair.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        root = compute_signing_root(ssz.altair.SyncAggregatorSelectionData, data, domain)
+        return self._sk(pubkey).sign(root).to_bytes()
+
+    def sign_contribution_and_proof(
+        self,
+        pubkey: bytes,
+        contribution: "ssz.altair.SyncCommitteeContribution",
+        aggregator_index: int,
+        selection_proof: bytes,
+    ) -> "ssz.altair.SignedContributionAndProof":
+        cp = ssz.altair.ContributionAndProof(
+            aggregator_index=aggregator_index,
+            contribution=contribution,
+            selection_proof=selection_proof,
+        )
+        epoch = compute_epoch_at_slot(contribution.slot)
+        domain = self._domain(DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
+        root = compute_signing_root(ssz.altair.ContributionAndProof, cp, domain)
+        sig = self._sk(pubkey).sign(root)
+        return ssz.altair.SignedContributionAndProof(
+            message=cp, signature=sig.to_bytes()
         )
 
     def sign_voluntary_exit(

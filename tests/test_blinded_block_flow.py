@@ -1,0 +1,127 @@
+"""Blinded-block / MEV-builder production flow end-to-end over REST.
+
+Reference flow (api/src/beacon/routes/validator.ts:168,248 +
+beacon-node/src/execution/builder/http.ts + publishBlindedBlock): the VC
+asks for a blinded block (body commits to the builder's
+ExecutionPayloadHeader bid), signs it — blinded and full blocks share
+their signing root by SSZ design — and publishes it to the
+blinded_blocks route, where the node unblinds via the builder
+(submitBlindedBlock reveals the payload) and imports the full block.
+"""
+import asyncio
+from dataclasses import replace
+
+import pytest
+
+from lodestar_tpu.api.client import ApiClient
+from lodestar_tpu.api.server import BeaconRestApiServer
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.config import ForkConfig, minimal_chain_config
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.execution.builder import MockBuilder
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ACTIVE_PRESET_NAME
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.state_transition.util.interop import interop_secret_keys
+from lodestar_tpu.types import ssz
+from lodestar_tpu.validator.validator import Validator
+from lodestar_tpu.validator.validator_store import ValidatorStore
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+cfg = replace(
+    minimal_chain_config,
+    ALTAIR_FORK_EPOCH=0,
+    BELLATRIX_FORK_EPOCH=0,
+    TERMINAL_TOTAL_DIFFICULTY=0,
+)
+
+
+class FakeTime:
+    def __init__(self, t0=0.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+
+def test_header_and_payload_share_roots():
+    # the property the whole blinded flow rests on
+    from lodestar_tpu.execution.engine import build_payload
+    from lodestar_tpu.params import ForkName
+
+    p = build_payload(
+        ForkName.bellatrix,
+        parent_hash=b"\x01" * 32,
+        timestamp=7,
+        prev_randao=b"\x02" * 32,
+        transactions=(b"\xaa\xbb",),
+    )
+    h = ssz.bellatrix.payload_to_header(p)
+    assert ssz.bellatrix.ExecutionPayload.hash_tree_root(
+        p
+    ) == ssz.bellatrix.ExecutionPayloadHeader.hash_tree_root(h)
+
+
+def test_vc_builder_blinded_proposal_end_to_end():
+    async def go():
+        _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+        ft = FakeTime(0.0)
+        chain = BeaconChain(
+            cfg, BeaconDb(), anchor, clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=ft)
+        )
+        builder = MockBuilder(chain=chain)
+        server = BeaconRestApiServer(chain, chain.db, builder=builder)
+        port = await server.listen()
+        api = ApiClient(f"http://127.0.0.1:{port}")
+
+        store = ValidatorStore(
+            interop_secret_keys(8),
+            ForkConfig(cfg),
+            chain.genesis_validators_root,
+        )
+        vc = Validator(api, store, use_builder=True, fee_recipient=b"\xfe" * 20)
+        await vc.initialize()
+
+        from lodestar_tpu.validator.chain_header_tracker import ChainHeaderTracker
+
+        tracker = ChainHeaderTracker(f"http://127.0.0.1:{port}")
+        await tracker.start()
+
+        for slot in range(1, 5):
+            ft.t = slot * cfg.SECONDS_PER_SLOT
+            await vc.run_slot(slot)
+
+        assert vc.produced_blocks == 4
+        head = chain.fork_choice.get_head()
+        assert head.slot == 4
+        # the imported head is the FULL block whose payload the builder
+        # revealed: block_hash chain is intact and block_number == slot
+        blk = chain.db.block.get(bytes.fromhex(head.block_root[2:]))
+        payload = blk.message.body.execution_payload
+        assert payload.block_number == 4
+        st = chain.get_head_state().state
+        assert bytes(st.latest_execution_payload_header.block_hash) == bytes(
+            payload.block_hash
+        )
+        # prepareBeaconProposer plumbed through to the builder bid: the
+        # MockBuilder consults the node's registrations... the node-side
+        # local production path reads them too; here the builder built the
+        # payload from the dev chain state, so check the server recorded
+        # the registrations (fee-recipient map) for every validator
+        assert set(server.fee_recipients) == set(range(8))
+        assert all(fr == b"\xfe" * 20 for fr in server.fee_recipients.values())
+
+        # chainHeaderTracker followed the head events pushed per import
+        await asyncio.sleep(0.1)
+        head2 = chain.fork_choice.get_head()
+        assert tracker.head_slot == head2.slot
+        assert tracker.head_root == bytes.fromhex(head2.block_root[2:])
+        await tracker.stop()
+
+        await api.close()
+        await server.close()
+
+    asyncio.run(go())
