@@ -1,0 +1,629 @@
+"""Conformance fixture generation: official-layout suites for every
+operation × fork, plus sanity / finality / fork-upgrade / rewards /
+fork_choice.
+
+Role: the reference DOWNLOADS ethereum/consensus-spec-tests
+(test/spec/specTestVersioning.ts:17-32) — impossible offline, so these
+generators write dev-chain transitions in the exact official directory
+layout and the same runners consume them.  Self-generated vectors are a
+REGRESSION oracle, not an independent one (the two external fixtures in
+tests/fixtures/external/ plus the blst/RFC KATs are the independent
+evidence); pointing LODESTAR_TPU_SPEC_TESTS at a real
+consensus-spec-tests checkout runs the identical harness against the
+official vectors (tests/test_official_vectors.py).
+
+Layout written per suite (single.ts consumption contract):
+
+    <root>/<fork>/<runner>/<handler>/pyspec_tests/<case>/
+        pre.ssz_snappy, post.ssz_snappy (absent => must fail), ...
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import replace
+from typing import Dict, List
+
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.params import ACTIVE_PRESET as _p, FORK_SEQ, ForkName
+from lodestar_tpu.state_transition import CachedBeaconState, process_slots
+from lodestar_tpu.types import fork_of_state, ssz, types_for
+
+from . import write_ssz_snappy, write_yaml
+
+E = _p.SLOTS_PER_EPOCH
+
+
+def config_for(fork: ForkName):
+    """Minimal-preset chain config with every fork up to `fork` at epoch 0."""
+    kw = {}
+    order = [
+        (ForkName.altair, "ALTAIR_FORK_EPOCH"),
+        (ForkName.bellatrix, "BELLATRIX_FORK_EPOCH"),
+        (ForkName.capella, "CAPELLA_FORK_EPOCH"),
+        (ForkName.eip4844, "EIP4844_FORK_EPOCH"),
+    ]
+    for f, attr in order:
+        if FORK_SEQ[f] <= FORK_SEQ[fork]:
+            kw[attr] = 0
+    if FORK_SEQ[fork] >= FORK_SEQ[ForkName.bellatrix]:
+        kw["TERMINAL_TOTAL_DIFFICULTY"] = 0
+    return replace(minimal_chain_config, **kw)
+
+
+def _case_dir(root: str, fork: ForkName, runner: str, handler: str, case: str) -> str:
+    return os.path.join(root, fork.value, runner, handler, "pyspec_tests", case)
+
+
+def _dev(fork: ForkName, slots: int) -> DevChain:
+    dc = DevChain(config_for(fork), 8, genesis_time=0)
+    dc.run_until(slots, verify_signatures=False)
+    return dc
+
+
+def _write_pre_post(case_dir, state_t, pre, post) -> None:
+    write_ssz_snappy(case_dir, "pre", state_t, pre)
+    if post is not None:
+        write_ssz_snappy(case_dir, "post", state_t, post)
+
+
+def _apply(cfg, pre, fn) -> object:
+    """Run fn against a clone; return post state (or raise)."""
+    cached = CachedBeaconState(cfg, pre)
+    work = cached.clone()
+    fn(work)
+    return work.state
+
+
+# ---------------------------------------------------------------------------
+# operations × forks
+# ---------------------------------------------------------------------------
+
+
+def _resolve_processor(fork: ForkName, name: str):
+    """The fork's processor for `name`, falling back down the fork ladder
+    — later forks reuse phase0's slashing/exit/header processors (which
+    internally fork-switch where the spec modifies behavior) without
+    re-exporting them as module attributes."""
+    from lodestar_tpu.state_transition.block import altair as b_altair, phase0 as b0
+    from lodestar_tpu.state_transition.state_transition import _PROCESSORS
+
+    chain = [_PROCESSORS[fork][0]]
+    if FORK_SEQ[fork] >= FORK_SEQ[ForkName.altair]:
+        chain.append(b_altair)
+    chain.append(b0)
+    for mod in chain:
+        fn = getattr(mod, name, None)
+        if fn is not None:
+            return fn
+    raise AttributeError(f"no processor {name} for {fork.value}")
+
+
+def operation_specs(fork: ForkName) -> Dict[str, tuple]:
+    """handler -> (op_stem, op_type, apply_fn(cfg, cached, op)).
+
+    ONE source of truth shared by the generator (below) and the
+    conformance runner (tests/test_spec_conformance.py builds
+    make_operations_runner from these), mirroring the reference's
+    operations.ts handler table."""
+    from lodestar_tpu.state_transition.block.process_deposit import (
+        process_deposit as _process_deposit,
+    )
+
+    specs: Dict[str, tuple] = {}
+
+    def _adv(w, slot):
+        if w.state.slot < slot:
+            process_slots(w, slot)
+
+    p_att = _resolve_processor(fork, "process_attestation")
+    p_hdr = _resolve_processor(fork, "process_block_header")
+    p_ps = _resolve_processor(fork, "process_proposer_slashing")
+    p_as = _resolve_processor(fork, "process_attester_slashing")
+    p_exit = _resolve_processor(fork, "process_voluntary_exit")
+
+    specs["attestation"] = (
+        "attestation",
+        ssz.phase0.Attestation,
+        lambda cfg, w, op: p_att(cfg, w.state, w.epoch_ctx, op, True),
+    )
+    specs["block_header"] = (
+        "block",
+        types_for(fork)[1],
+        lambda cfg, w, op: (
+            _adv(w, op.slot),
+            p_hdr(cfg, w.state, w.epoch_ctx, op),
+        ),
+    )
+    specs["proposer_slashing"] = (
+        "proposer_slashing",
+        ssz.phase0.ProposerSlashing,
+        lambda cfg, w, op: p_ps(cfg, w.state, w.epoch_ctx, op, True),
+    )
+    specs["attester_slashing"] = (
+        "attester_slashing",
+        ssz.phase0.AttesterSlashing,
+        lambda cfg, w, op: p_as(cfg, w.state, w.epoch_ctx, op, True),
+    )
+    specs["voluntary_exit"] = (
+        "voluntary_exit",
+        ssz.phase0.SignedVoluntaryExit,
+        lambda cfg, w, op: p_exit(cfg, w.state, w.epoch_ctx, op, True),
+    )
+    specs["deposit"] = (
+        "deposit",
+        ssz.phase0.Deposit,
+        lambda cfg, w, op: _process_deposit(fork, cfg, w.state, op),
+    )
+    if FORK_SEQ[fork] >= FORK_SEQ[ForkName.altair]:
+        p_sync = _resolve_processor(fork, "process_sync_aggregate")
+
+        def apply_sync_aggregate(cfg, w, op):
+            # synthesize a block at the state's slot carrying the
+            # aggregate — the signature set derives the signed root from
+            # the STATE (block root at slot-1), the block only supplies
+            # slot + aggregate
+            block_t = types_for(fork)[1]
+            blk = block_t.default()
+            blk.slot = int(w.state.slot)
+            blk.body.sync_aggregate = op
+            p_sync(cfg, w.state, w.epoch_ctx, blk, True)
+
+        specs["sync_aggregate"] = (
+            "sync_aggregate", ssz.altair.SyncAggregate, apply_sync_aggregate
+        )
+    if FORK_SEQ[fork] >= FORK_SEQ[ForkName.bellatrix]:
+        from lodestar_tpu.state_transition.block import bellatrix as bm
+
+        payload_t = getattr(ssz, fork.value).ExecutionPayload
+
+        def apply_execution_payload(cfg, w, op, case=None):
+            # official cases carry execution.yaml {execution_valid: bool}
+            # for engine-rejected payloads (test/spec: operations/
+            # execution_payload); model the engine verdict with a stub
+            engine = None
+            if case is not None and case.has("execution"):
+                valid = bool(case.yaml("execution").get("execution_valid", True))
+
+                class _Engine:
+                    def notify_new_payload_sync(self, payload, _v=valid):
+                        return _v
+
+                engine = _Engine()
+            body = types_for(fork)[3].default()
+            body.execution_payload = op
+            bm.process_execution_payload(cfg, w.state, body, engine)
+
+        specs["execution_payload"] = (
+            "execution_payload", payload_t, apply_execution_payload
+        )
+    if FORK_SEQ[fork] >= FORK_SEQ[ForkName.capella]:
+        from lodestar_tpu.state_transition.block import capella as bc
+
+        specs["withdrawals"] = (
+            "execution_payload",
+            getattr(ssz, fork.value).ExecutionPayload,
+            lambda cfg, w, op: bc.process_withdrawals(cfg, w.state, op),
+        )
+        specs["bls_to_execution_change"] = (
+            "address_change",
+            ssz.capella.SignedBLSToExecutionChange,
+            lambda cfg, w, op: bc.process_bls_to_execution_change(
+                cfg, w.state, op, True
+            ),
+        )
+    return specs
+
+
+def gen_operations(root: str, fork: ForkName) -> List[str]:
+    """Write operations/<handler> suites for every operation the fork has.
+
+    Valid cases come from live dev-chain objects; each handler also gets
+    at least one invalid case (post absent => the runner must raise).
+    Apply semantics come from operation_specs() — the SAME table the
+    conformance runner consumes, so generation and verification cannot
+    drift apart."""
+    from lodestar_tpu import flare
+    from lodestar_tpu.state_transition.util.interop import interop_secret_keys
+
+    cfg = config_for(fork)
+    state_t, block_t, signed_t, _ = types_for(fork)
+    specs = operation_specs(fork)
+    dc = _dev(fork, 2 * E + 2)
+    sks = dc.sks
+    gvr = bytes(dc.head.state.genesis_validators_root)
+    written = []
+
+    def emit(handler: str, case: str, pre, op):
+        stem, op_t, apply_fn = specs[handler]
+        case_dir = _case_dir(root, fork, "operations", handler, case)
+        write_ssz_snappy(case_dir, stem, op_t, op)
+        try:
+            post = _apply(cfg, pre, lambda w: apply_fn(cfg, w, op))
+        except ValueError:
+            # the STF contract: invalid operations raise ValueError — any
+            # OTHER exception is a harness bug and must crash generation,
+            # not become an expected-failure fixture
+            post = None
+        _write_pre_post(case_dir, state_t, pre, post)
+        written.append(f"operations/{handler}/{case}")
+
+    head = dc.head.state
+
+    # -- attestation ----------------------------------------------------
+    att = dc.attest(int(head.slot))[0]
+    emit("attestation", "valid_head_att", head, att)
+    bad_att = ssz.phase0.Attestation(
+        aggregation_bits=list(att.aggregation_bits),
+        data=att.data.replace(target=att.data.target.replace(epoch=99)),
+        signature=bytes(att.signature),
+    )
+    emit("attestation", "invalid_target_epoch", head, bad_att)
+
+    # -- block_header ----------------------------------------------------
+    blk = dc.produce_block(int(head.slot) + 1)
+    emit("block_header", "valid_next_block", head, blk.message)
+    emit(
+        "block_header", "invalid_proposer", head,
+        blk.message.replace(proposer_index=7 - blk.message.proposer_index),
+    )
+
+    # -- proposer/attester slashing --------------------------------------
+    ps = flare.make_self_proposer_slashing(cfg, gvr, sks[2], 2, int(head.slot))
+    emit("proposer_slashing", "valid_double_proposal", head, ps)
+    emit(
+        "proposer_slashing", "invalid_same_header", head,
+        ssz.phase0.ProposerSlashing(
+            signed_header_1=ps.signed_header_1, signed_header_2=ps.signed_header_1
+        ),
+    )
+    asl = flare.make_self_attester_slashing(
+        cfg, gvr, sks[3], 3, int(head.slot) // E
+    )
+    emit("attester_slashing", "valid_double_vote", head, asl)
+    emit(
+        "attester_slashing", "invalid_same_attestation", head,
+        ssz.phase0.AttesterSlashing(
+            attestation_1=asl.attestation_1, attestation_2=asl.attestation_1
+        ),
+    )
+
+    # -- voluntary_exit ---------------------------------------------------
+    from lodestar_tpu.config import ForkConfig
+    from lodestar_tpu.validator.validator_store import ValidatorStore
+
+    period = cfg.SHARD_COMMITTEE_PERIOD
+    deep = dc.head.clone()
+    process_slots(deep, (period + 3) * E)
+    store = ValidatorStore(interop_secret_keys(8), ForkConfig(cfg), gvr)
+    exit_ = store.sign_voluntary_exit(store.pubkeys[5], 5, period + 2)
+    emit("voluntary_exit", "valid_exit", deep.state, exit_)
+    emit("voluntary_exit", "invalid_too_early", head, exit_)
+
+    # -- deposit ----------------------------------------------------------
+    _gen_deposit_cases(root, fork, cfg, emit)
+
+    # -- sync_aggregate (altair+) ----------------------------------------
+    if "sync_aggregate" in specs:
+        nxt = dc.produce_block(int(head.slot) + 1)
+        adv = dc.head.clone()
+        process_slots(adv, int(head.slot) + 1)
+        agg = nxt.message.body.sync_aggregate
+        emit("sync_aggregate", "valid_from_block", adv.state, agg)
+        flipped = list(agg.sync_committee_bits)
+        if any(flipped):
+            flipped[next(i for i, b in enumerate(flipped) if b)] = False
+            emit(
+                "sync_aggregate", "invalid_bit_flip", adv.state,
+                ssz.altair.SyncAggregate(
+                    sync_committee_bits=flipped,
+                    sync_committee_signature=bytes(agg.sync_committee_signature),
+                ),
+            )
+
+    # -- execution_payload (bellatrix+) ----------------------------------
+    if "execution_payload" in specs:
+        from lodestar_tpu.execution.engine import build_dev_payload
+
+        adv = dc.head.clone()
+        process_slots(adv, int(head.slot) + 1)
+        payload = build_dev_payload(cfg, adv.state)
+        emit("execution_payload", "valid_dev_payload", adv.state, payload)
+        bad_payload = payload.copy()
+        bad_payload.parent_hash = b"\x13" * 32
+        emit("execution_payload", "invalid_parent_hash", adv.state, bad_payload)
+
+    # -- withdrawals + bls_to_execution_change (capella+) -----------------
+    if "withdrawals" in specs:
+        from lodestar_tpu.state_transition.block import capella as bc
+        from lodestar_tpu.execution.engine import build_dev_payload as _bdp
+
+        wstate = head.copy()
+        wstate.validators[2] = wstate.validators[2].replace(
+            withdrawal_credentials=b"\x01" + b"\x00" * 11 + b"\xaa" * 20
+        )
+        wstate.balances[2] = _p.MAX_EFFECTIVE_BALANCE + 12345
+        expected = bc.get_expected_withdrawals(wstate)
+        wp = _bdp(cfg, wstate)
+        wp.withdrawals = list(expected)
+        emit("withdrawals", "valid_partial_withdrawal", wstate, wp)
+        bad_wp = wp.copy()
+        if expected:
+            bad_wp.withdrawals = [
+                expected[0].replace(amount=expected[0].amount + 1)
+            ] + list(expected[1:])
+        emit("withdrawals", "invalid_amount", wstate, bad_wp)
+
+    if "bls_to_execution_change" in specs:
+        from lodestar_tpu.params import DOMAIN_BLS_TO_EXECUTION_CHANGE
+        from lodestar_tpu.state_transition.util.domain import (
+            compute_domain,
+            compute_signing_root,
+        )
+
+        idx = 5
+        change = ssz.capella.BLSToExecutionChange(
+            validator_index=idx,
+            from_bls_pubkey=sks[idx].to_public_key().to_bytes(),
+            to_execution_address=b"\xdd" * 20,
+        )
+        domain = compute_domain(
+            DOMAIN_BLS_TO_EXECUTION_CHANGE, cfg.GENESIS_FORK_VERSION, gvr
+        )
+        sig = sks[idx].sign(
+            compute_signing_root(ssz.capella.BLSToExecutionChange, change, domain)
+        )
+        signed = ssz.capella.SignedBLSToExecutionChange(
+            message=change, signature=sig.to_bytes()
+        )
+        emit("bls_to_execution_change", "valid_change", head, signed)
+        bad = ssz.capella.SignedBLSToExecutionChange(
+            message=change.replace(to_execution_address=b"\xee" * 20),
+            signature=sig.to_bytes(),
+        )
+        emit("bls_to_execution_change", "invalid_signature", head, bad)
+
+    return written
+
+
+def _gen_deposit_cases(root, fork, cfg, emit):
+    """Deposit cases: build a 9-leaf interop deposit tree, initialize a
+    state from the first 8 deposits with eth1_data committing to all 9,
+    then the 9th deposit (valid proof) applies cleanly; a corrupted
+    proof must fail."""
+    from lodestar_tpu.state_transition.util import genesis as g
+
+    deposits = g.interop_deposits(cfg, 9)
+    pre8 = g.initialize_beacon_state_from_eth1(cfg, b"B" * 32, 2**40, deposits[:8])
+    # commit the eth1 data to the FULL 9-leaf tree so deposit 8 proves
+    full = g.initialize_beacon_state_from_eth1(cfg, b"B" * 32, 2**40, deposits)
+    pre8.eth1_data = ssz.phase0.Eth1Data(
+        deposit_root=bytes(full.eth1_data.deposit_root),
+        deposit_count=9,
+        block_hash=bytes(full.eth1_data.block_hash),
+    )
+    # fork-match the pre state (deposit processing is fork-generic)
+    pre = _upgrade_to(cfg, pre8, fork)
+    dep = deposits[8]
+    emit("deposit", "valid_new_validator", pre, dep)
+    bad_proof = list(dep.proof)
+    bad_proof[0] = b"\x77" * 32
+    emit(
+        "deposit", "invalid_proof", pre,
+        ssz.phase0.Deposit(proof=bad_proof, data=dep.data),
+    )
+
+
+def upgrade_ladder():
+    """fork -> its upgrade function, in canonical order — the single copy
+    shared by _upgrade_to, gen_fork_upgrade, and the conformance tests."""
+    from lodestar_tpu.state_transition import upgrade as upg
+
+    return {
+        ForkName.altair: upg.upgrade_to_altair,
+        ForkName.bellatrix: upg.upgrade_to_bellatrix,
+        ForkName.capella: upg.upgrade_to_capella,
+        ForkName.eip4844: upg.upgrade_to_eip4844,
+    }
+
+
+def _upgrade_to(cfg, phase0_state, fork: ForkName):
+    """Chain the upgrade functions from phase0 up to `fork`."""
+    state = phase0_state
+    for f, fn in upgrade_ladder().items():
+        if FORK_SEQ[f] <= FORK_SEQ[fork]:
+            state = fn(cfg, state, CachedBeaconState(cfg, state).epoch_ctx)
+    return state
+
+
+def rewards_components(cfg, state, proc):
+    """stem -> (rewards, penalties) — the single component table shared
+    by gen_rewards and make_rewards_runner (drift-proof by construction)."""
+    from lodestar_tpu.params import (
+        TIMELY_HEAD_FLAG_INDEX,
+        TIMELY_SOURCE_FLAG_INDEX,
+        TIMELY_TARGET_FLAG_INDEX,
+    )
+    from lodestar_tpu.state_transition.epoch import altair as ea
+
+    return {
+        "source_deltas": ea.get_flag_index_deltas(
+            cfg, state, proc, TIMELY_SOURCE_FLAG_INDEX
+        ),
+        "target_deltas": ea.get_flag_index_deltas(
+            cfg, state, proc, TIMELY_TARGET_FLAG_INDEX
+        ),
+        "head_deltas": ea.get_flag_index_deltas(
+            cfg, state, proc, TIMELY_HEAD_FLAG_INDEX
+        ),
+        "inactivity_penalty_deltas": ea.get_inactivity_penalty_deltas(
+            cfg, state, proc
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# sanity / finality / fork / rewards / fork_choice
+# ---------------------------------------------------------------------------
+
+
+def gen_sanity(root: str, fork: ForkName) -> None:
+    cfg = config_for(fork)
+    state_t, _, signed_t, _ = types_for(fork)
+    dc = _dev(fork, E + 1)
+    pre = dc.head.state
+
+    case_dir = _case_dir(root, fork, "sanity", "slots", "advance_epoch")
+    w = CachedBeaconState(cfg, pre).clone()
+    _write_pre_post(case_dir, state_t, pre, None)
+    write_yaml(case_dir, "slots", E)
+    process_slots(w, w.state.slot + E)
+    write_ssz_snappy(case_dir, "post", state_t, w.state)
+
+    blk = dc.produce_block(int(pre.slot) + 1)
+    case_dir = _case_dir(root, fork, "sanity", "blocks", "one_block")
+    from lodestar_tpu.state_transition import state_transition
+
+    post = state_transition(
+        CachedBeaconState(cfg, pre), blk,
+        verify_state_root=True, verify_proposer=True, verify_signatures=True,
+    )
+    write_ssz_snappy(case_dir, "pre", state_t, pre)
+    write_yaml(case_dir, "meta", {"blocks_count": 1})
+    write_ssz_snappy(case_dir, "blocks_0", signed_t, blk)
+    write_ssz_snappy(case_dir, "post", state_t, post.state)
+
+
+def gen_finality(root: str, fork: ForkName) -> None:
+    """finality/finality: 3+ epochs of blocks finalizing an epoch."""
+    cfg = config_for(fork)
+    state_t, _, signed_t, _ = types_for(fork)
+    dc = DevChain(cfg, 8, genesis_time=0)
+    pre = dc.head.state.copy()
+    blocks = []
+    for slot in range(1, 4 * E + 1):
+        if slot > 1:
+            dc.attest(slot - 1)
+        blk = dc.produce_block(slot)
+        dc.import_block(blk, verify_signatures=False)
+        blocks.append(blk)
+    assert dc.head.state.finalized_checkpoint.epoch > 0, "no finality reached"
+    case_dir = _case_dir(root, fork, "finality", "finality", "finalize_epochs")
+    write_ssz_snappy(case_dir, "pre", state_t, pre)
+    write_yaml(case_dir, "meta", {"blocks_count": len(blocks)})
+    for i, blk in enumerate(blocks):
+        write_ssz_snappy(case_dir, f"blocks_{i}", signed_t, blk)
+    write_ssz_snappy(case_dir, "post", state_t, dc.head.state)
+
+
+def gen_fork_upgrade(root: str, post_fork: ForkName) -> None:
+    """fork/fork: a pre-fork state and its upgraded form."""
+    forks = list(upgrade_ladder())
+    pre_fork = (
+        ForkName.phase0
+        if post_fork is forks[0]
+        else forks[forks.index(post_fork) - 1]
+    )
+    fn = upgrade_ladder()[post_fork]
+    cfg = config_for(pre_fork)
+    dc = _dev(pre_fork, E + 1)
+    pre = dc.head.state
+    post = fn(cfg, pre.copy(), CachedBeaconState(cfg, pre.copy()).epoch_ctx)
+    case_dir = _case_dir(root, post_fork, "fork", "fork", "upgrade")
+    write_ssz_snappy(case_dir, "pre", types_for(pre_fork)[0], pre)
+    write_ssz_snappy(case_dir, "post", types_for(post_fork)[0], post)
+    write_yaml(case_dir, "meta", {"fork": post_fork.value})
+
+
+def gen_rewards(root: str, fork: ForkName) -> None:
+    """rewards/basic: per-component Deltas at an epoch boundary (the
+    component table is shared with make_rewards_runner)."""
+    from lodestar_tpu.state_transition.epoch import altair as ea
+    from .runners import _deltas_type
+
+    cfg = config_for(fork)
+    state_t = types_for(fork)[0]
+    dc = _dev(fork, 2 * E)
+    pre = dc.head.state
+    cached = CachedBeaconState(cfg, pre)
+    proc = ea.before_process_epoch(cfg, cached.state, cached.epoch_ctx)
+    deltas_t = _deltas_type()
+    case_dir = _case_dir(root, fork, "rewards", "basic", "epoch_boundary")
+    write_ssz_snappy(case_dir, "pre", state_t, pre)
+    for stem, (r, p) in rewards_components(cfg, cached.state, proc).items():
+        write_ssz_snappy(
+            case_dir, stem, deltas_t,
+            deltas_t(rewards=[int(x) for x in r], penalties=[int(x) for x in p]),
+        )
+
+
+def gen_fork_choice(root: str, fork: ForkName) -> None:
+    """fork_choice/on_block: ticks + blocks + head/checkpoint checks from
+    a dev-chain run (official steps.yaml layout)."""
+    cfg = config_for(fork)
+    state_t, block_t, signed_t, _ = types_for(fork)
+    dc = DevChain(cfg, 8, genesis_time=0)
+    anchor_state = dc.head.state.copy()
+    anchor_block = block_t.default()
+    # anchor block mirrors the genesis latest_block_header with state root
+    anchor_block = anchor_block.replace(
+        slot=anchor_state.slot,
+        state_root=type(anchor_state).hash_tree_root(anchor_state),
+    )
+    steps: List[dict] = []
+    blocks: Dict[str, object] = {}
+    n = 3 * E + 1
+    for slot in range(1, n + 1):
+        if slot > 1:
+            dc.attest(slot - 1)
+        blk = dc.produce_block(slot)
+        dc.import_block(blk, verify_signatures=False)
+        steps.append({"tick": slot * cfg.SECONDS_PER_SLOT})
+        name = f"block_{slot - 1}"
+        blocks[name] = blk
+        steps.append({"block": name})
+    steps.append(
+        {
+            "checks": {
+                "head": {
+                    "slot": int(dc.head.state.slot),
+                    "root": "0x" + dc._head_root().hex(),
+                },
+                "justified_checkpoint": {
+                    "epoch": int(dc.head.state.current_justified_checkpoint.epoch)
+                },
+                "finalized_checkpoint": {
+                    "epoch": int(dc.head.state.finalized_checkpoint.epoch)
+                },
+            }
+        }
+    )
+    case_dir = _case_dir(root, fork, "fork_choice", "on_block", "chain_3_epochs")
+    write_ssz_snappy(case_dir, "anchor_state", state_t, anchor_state)
+    write_ssz_snappy(case_dir, "anchor_block", block_t, anchor_block)
+    for name, blk in blocks.items():
+        write_ssz_snappy(case_dir, name, signed_t, blk)
+    write_yaml(case_dir, "steps", steps)
+
+
+ALL_FORKS = [
+    ForkName.phase0,
+    ForkName.altair,
+    ForkName.bellatrix,
+    ForkName.capella,
+    ForkName.eip4844,
+]
+
+
+def generate_all(root: str, forks=None) -> None:
+    for fork in forks or ALL_FORKS:
+        gen_operations(root, fork)
+        gen_sanity(root, fork)
+        if fork is not ForkName.phase0:
+            gen_fork_upgrade(root, fork)
+        if FORK_SEQ[fork] >= FORK_SEQ[ForkName.altair]:
+            gen_rewards(root, fork)
+    # the heavier multi-epoch suites on the two ends of the fork ladder
+    for fork in (ForkName.phase0, (forks or ALL_FORKS)[-1]):
+        gen_finality(root, fork)
+        gen_fork_choice(root, fork)

@@ -22,14 +22,22 @@ def _state_type_of(case: SpecTestCase, fork):
 def make_operations_runner(cfg, fork, operation_stem: str, op_type, apply_fn):
     """Suite: operations/<op> — pre + operation -> post (or failure).
 
-    apply_fn(cfg, cached_state, operation) mutates the cached state."""
+    apply_fn(cfg, cached_state, operation) mutates the cached state;
+    handlers that need sibling files (execution.yaml engine verdicts)
+    declare a `case` keyword and receive the SpecTestCase."""
+    import inspect
+
     state_t = types_for(fork)[0]
+    takes_case = "case" in inspect.signature(apply_fn).parameters
 
     def runner(case: SpecTestCase):
         pre = case.ssz("pre", state_t)
         op = case.ssz(operation_stem, op_type)
         cached = CachedBeaconState(cfg, pre)
-        apply_fn(cfg, cached, op)
+        if takes_case:
+            apply_fn(cfg, cached, op, case=case)
+        else:
+            apply_fn(cfg, cached, op)
         return state_t.serialize(cached.state)
 
     return runner
@@ -97,6 +105,197 @@ def make_ssz_static_runner(ssz_type):
             raise AssertionError(f"root {got_root} != {roots['root']}")
         if ssz_type.serialize(value) != data:
             raise AssertionError("serialization round-trip mismatch")
+        return None
+
+    return runner
+
+
+def make_finality_runner(cfg, fork):
+    """Suite: finality/finality — identical layout to sanity/blocks
+    (pre + blocks_i -> post), the cases just push the chain through
+    justification/finalization transitions (test/spec/presets/finality.ts)."""
+    return make_sanity_blocks_runner(cfg, fork)
+
+
+def make_fork_upgrade_runner(cfg, pre_fork, upgrade_fn):
+    """Suite: fork/fork — pre (old-fork state) -> post (upgraded state)
+    (test/spec/presets/fork.ts)."""
+    pre_t = types_for(pre_fork)[0]
+
+    def runner(case: SpecTestCase):
+        pre = case.ssz("pre", pre_t)
+        post = upgrade_fn(cfg, pre, CachedBeaconState(cfg, pre).epoch_ctx)
+        return type(post).serialize(post)
+
+    return runner
+
+
+def make_rewards_runner(cfg, fork):
+    """Suite: rewards/* (altair+ layout): pre -> per-component Deltas
+    files {source,target,head}_deltas + inactivity_penalty_deltas
+    (test/spec/presets/rewards.ts).  The component table comes from
+    fixtures.rewards_components — the same table generation uses."""
+    from lodestar_tpu.state_transition.epoch import altair as ea
+    from .fixtures import rewards_components
+
+    state_t = types_for(fork)[0]
+    deltas_t = _deltas_type()
+
+    def runner(case: SpecTestCase):
+        pre = case.ssz("pre", state_t)
+        cached = CachedBeaconState(cfg, pre)
+        proc = ea.before_process_epoch(cfg, cached.state, cached.epoch_ctx)
+        components = rewards_components(cfg, cached.state, proc)
+        checked = 0
+        for stem, (rewards, penalties) in components.items():
+            if not case.has(stem):
+                continue
+            got = deltas_t.serialize(
+                deltas_t(
+                    rewards=[int(x) for x in rewards],
+                    penalties=[int(x) for x in penalties],
+                )
+            )
+            if got != case.raw(stem):
+                raise AssertionError(f"{stem} mismatch")
+            checked += 1
+        if checked == 0:
+            raise AssertionError("no known delta component files in case")
+        return None
+
+    return runner
+
+
+_DELTAS_T = None
+
+
+def _deltas_type():
+    """Deltas{rewards, penalties} (built via the metaclass directly —
+    this module's `from __future__ import annotations` would turn class-
+    body annotations into strings, which ContainerMeta rejects)."""
+    global _DELTAS_T
+    if _DELTAS_T is None:
+        from lodestar_tpu.params import ACTIVE_PRESET as _p
+        from lodestar_tpu.ssz import core as sszc
+
+        lst = sszc.List[sszc.uint64, _p.VALIDATOR_REGISTRY_LIMIT]
+        _DELTAS_T = sszc.ContainerMeta(
+            "Deltas",
+            (sszc.Container,),
+            {"__annotations__": {"rewards": lst, "penalties": lst}},
+        )
+    return _DELTAS_T
+
+
+def make_fork_choice_runner(cfg, fork):
+    """Suite: fork_choice/* — anchor_state + anchor_block + steps.yaml
+    driving ticks/blocks/attestations with interleaved head/checkpoint
+    checks (test/spec/presets/fork_choice.ts).  Steps run through a full
+    BeaconChain (clock + block pipeline + fork choice), i.e. the same
+    integrated path gossip and sync use; block signatures are assumed
+    pre-validated like the reference's fork-choice harness."""
+    import asyncio
+
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.chain.clock import LocalClock
+    from lodestar_tpu.db import BeaconDb
+
+    state_t, block_t, signed_t, _ = types_for(fork)
+    att_t = ssz.phase0.Attestation
+
+    class _TrustAll:
+        async def verify_signature_sets(self, sets, opts=None):
+            return True
+
+    def runner(case: SpecTestCase):
+        anchor_state = case.ssz("anchor_state", state_t)
+        case.ssz("anchor_block", block_t)  # layout presence check
+
+        class _FT:
+            t = float(anchor_state.genesis_time)
+
+            def __call__(self):
+                return self.t
+
+        ft = _FT()
+        chain = BeaconChain(
+            cfg,
+            BeaconDb(),
+            anchor_state,
+            verifier=_TrustAll(),
+            clock=LocalClock(
+                anchor_state.genesis_time, cfg.SECONDS_PER_SLOT, now=ft
+            ),
+        )
+
+        async def drive():
+            for step in case.yaml("steps"):
+                if "tick" in step:
+                    ft.t = anchor_state.genesis_time + int(step["tick"])
+                    chain.fork_choice.update_time(chain.clock.current_slot)
+                elif "block" in step:
+                    signed = case.ssz(step["block"], signed_t)
+                    try:
+                        await chain.process_block(signed)
+                    except ValueError:
+                        if step.get("valid", True):
+                            raise
+                        continue
+                    if not step.get("valid", True):
+                        raise AssertionError(
+                            f"{step['block']}: invalid block imported"
+                        )
+                elif "attestation" in step:
+                    att = case.ssz(step["attestation"], att_t)
+                    # committee from the ATTESTED block's imported state
+                    # (head shuffling is wrong/absent for side-fork or
+                    # older-epoch attestations); head is the fallback for
+                    # attestations to blocks this harness never imported
+                    st = chain.state_cache.get(
+                        bytes(att.data.beacon_block_root)
+                    ) or chain.get_head_state()
+                    committee = st.epoch_ctx.get_committee(
+                        att.data.slot, att.data.index
+                    )
+                    indices = [
+                        committee[i]
+                        for i, bit in enumerate(att.aggregation_bits)
+                        if bit
+                    ]
+                    chain.fork_choice.on_attestation(
+                        indices,
+                        "0x" + bytes(att.data.beacon_block_root).hex(),
+                        att.data.target.epoch,
+                    )
+                elif "checks" in step:
+                    checks = step["checks"]
+                    head = chain.fork_choice.update_head()
+                    if "head" in checks:
+                        want = checks["head"]
+                        if int(want["slot"]) != head.slot:
+                            raise AssertionError(
+                                f"head slot {head.slot} != {want['slot']}"
+                            )
+                        if want.get("root") and want["root"] != head.block_root:
+                            raise AssertionError(
+                                f"head root {head.block_root} != {want['root']}"
+                            )
+                    if "justified_checkpoint" in checks:
+                        want = checks["justified_checkpoint"]
+                        got = chain.fork_choice.store.justified
+                        if int(want["epoch"]) != got.epoch:
+                            raise AssertionError(
+                                f"justified epoch {got.epoch} != {want['epoch']}"
+                            )
+                    if "finalized_checkpoint" in checks:
+                        want = checks["finalized_checkpoint"]
+                        got = chain.fork_choice.store.finalized
+                        if int(want["epoch"]) != got.epoch:
+                            raise AssertionError(
+                                f"finalized epoch {got.epoch} != {want['epoch']}"
+                            )
+
+        asyncio.run(drive())
         return None
 
     return runner
