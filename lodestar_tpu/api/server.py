@@ -586,6 +586,10 @@ class BeaconRestApiServer:
         from lodestar_tpu.types import fork_of_block
 
         fork = fork_of_block(block)
+        if self.chain.metrics:
+            self.chain.metrics.lodestar.blocks_produced_total.labels(
+                flavor="full"
+            ).inc()
         return _ok(
             to_json(type(block), block),
             version=fork.value,
@@ -594,7 +598,11 @@ class BeaconRestApiServer:
 
     async def _produce_block(self, slot, randao_reveal, graffiti=""):
         """produceBlockWrapper + produceBlockBody in miniature."""
+        import time as _time
+
         from lodestar_tpu.state_transition import process_slots, state_transition
+
+        _t0 = _time.perf_counter()
 
         head_state = self.chain.get_head_state()
         pre = head_state.clone()
@@ -675,6 +683,10 @@ class BeaconRestApiServer:
             verify_state_root=False, verify_proposer=False, verify_signatures=False,
         )
         block.state_root = post.hash_tree_root()
+        if self.chain.metrics:
+            self.chain.metrics.lodestar.produce_block_seconds.observe(
+                _time.perf_counter() - _t0
+            )
         return block
 
     async def produce_blinded_block(self, request):
@@ -714,6 +726,8 @@ class BeaconRestApiServer:
                 header = bid.message.header
             except Exception as e:
                 return _err(502, f"builder getHeader failed: {e}")
+            if self.chain.metrics:
+                self.chain.metrics.lodestar.builder_bids_total.inc()
             # builder payload differs from the local one: re-run the
             # (blinded) STF to get the right post-state root
             trial_body = blinded_body_t(
@@ -742,6 +756,10 @@ class BeaconRestApiServer:
             state_root=state_root,
             body=blinded_body_t(execution_payload_header=header, **body_kwargs),
         )
+        if self.chain.metrics:
+            self.chain.metrics.lodestar.blocks_produced_total.labels(
+                flavor="blinded"
+            ).inc()
         return _ok(
             to_json(blinded_block_t, blinded),
             version=fork.value,
@@ -773,6 +791,8 @@ class BeaconRestApiServer:
             signed.message.body.execution_payload_header.block_hash
         ):
             return _err(400, "builder revealed a different payload")
+        if self.chain.metrics:
+            self.chain.metrics.lodestar.builder_unblinds_total.inc()
         _, block_t, signed_t, body_t = types_for(fork)
         body_kwargs = {
             n: getattr(signed.message.body, n)

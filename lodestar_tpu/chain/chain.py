@@ -324,7 +324,12 @@ class BeaconChain:
                     )
                     if not ok:
                         raise ValueError("invalid terminal pow block")
-            return await self.execution_engine.notify_new_payload(payload)
+            res = await self.execution_engine.notify_new_payload(payload)
+            if self.metrics and res is not None:
+                self.metrics.lodestar.engine_new_payload_total.labels(
+                    status=str(getattr(res.status, "value", res.status)).lower()
+                ).inc()
+            return res
 
         def run_stf():
             t0 = time.perf_counter()

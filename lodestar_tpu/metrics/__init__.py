@@ -112,6 +112,54 @@ class LodestarMetrics:
             "Attestations buffered for aggregation/packing",
             registry=registry,
         )
+        # range sync (sync/range metrics role: batches by terminal status,
+        # usable peers, current chain target)
+        self.sync_batches_total = Counter(
+            f"{ns}_sync_batches_total",
+            "Range-sync batches by outcome",
+            ["status"],  # downloaded | processed | retried | failed
+            registry=registry,
+        )
+        self.sync_peers = Gauge(
+            f"{ns}_sync_peers",
+            "Peers whose status can serve the current sync window",
+            registry=registry,
+        )
+        self.sync_target_slot = Gauge(
+            f"{ns}_sync_target_slot",
+            "Best peer head slot the range sync is driving toward",
+            registry=registry,
+        )
+        # execution / builder (execution engine + builder http.ts roles)
+        self.engine_new_payload_total = Counter(
+            f"{ns}_engine_new_payload_total",
+            "notifyNewPayload calls by engine verdict",
+            ["status"],  # valid | invalid
+            registry=registry,
+        )
+        self.builder_bids_total = Counter(
+            f"{ns}_builder_bids_total",
+            "Builder getHeader bids fetched",
+            registry=registry,
+        )
+        self.builder_unblinds_total = Counter(
+            f"{ns}_builder_unblinds_total",
+            "Blinded blocks revealed via submitBlindedBlock",
+            registry=registry,
+        )
+        # block production (api/impl produceBlock role)
+        self.blocks_produced_total = Counter(
+            f"{ns}_blocks_produced_total",
+            "Blocks produced over REST by flavor",
+            ["flavor"],  # full | blinded
+            registry=registry,
+        )
+        self.produce_block_seconds = Histogram(
+            f"{ns}_produce_block_seconds",
+            "Wall time of produceBlock (pool packing + trial STF + root)",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5),
+            registry=registry,
+        )
 
 
 class Metrics:

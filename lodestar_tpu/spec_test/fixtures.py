@@ -245,20 +245,37 @@ def gen_operations(root: str, fork: ForkName) -> List[str]:
             # OTHER exception is a harness bug and must crash generation,
             # not become an expected-failure fixture
             post = None
+        # the case NAME is the generator's intent; a valid_* case that
+        # failed to apply (or an invalid_* that applied) is a generator
+        # bug that would otherwise ship as silently-wrong coverage
+        if case.startswith("valid") and post is None:
+            raise AssertionError(
+                f"{fork.value}/operations/{handler}/{case}: intended-valid "
+                "case failed to apply"
+            )
+        if case.startswith("invalid") and post is not None:
+            raise AssertionError(
+                f"{fork.value}/operations/{handler}/{case}: intended-invalid "
+                "case applied cleanly"
+            )
         _write_pre_post(case_dir, state_t, pre, post)
         written.append(f"operations/{handler}/{case}")
 
     head = dc.head.state
 
     # -- attestation ----------------------------------------------------
+    # pre advanced one slot past the attested slot so the inclusion
+    # delay (MIN_ATTESTATION_INCLUSION_DELAY) is satisfied
     att = dc.attest(int(head.slot))[0]
-    emit("attestation", "valid_head_att", head, att)
+    att_pre = dc.head.clone()
+    process_slots(att_pre, int(head.slot) + 1)
+    emit("attestation", "valid_head_att", att_pre.state, att)
     bad_att = ssz.phase0.Attestation(
         aggregation_bits=list(att.aggregation_bits),
         data=att.data.replace(target=att.data.target.replace(epoch=99)),
         signature=bytes(att.signature),
     )
-    emit("attestation", "invalid_target_epoch", head, bad_att)
+    emit("attestation", "invalid_target_epoch", att_pre.state, bad_att)
 
     # -- block_header ----------------------------------------------------
     blk = dc.produce_block(int(head.slot) + 1)

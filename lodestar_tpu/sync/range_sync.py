@@ -78,6 +78,11 @@ class RangeSync:
         self.chain = chain
         self.batch_buffer = batch_buffer
         self.imported = 0
+        self._metrics = getattr(chain, "metrics", None)
+
+    def _count_batch(self, status: str) -> None:
+        if self._metrics:
+            self._metrics.lodestar.sync_batches_total.labels(status=status).inc()
 
     def _target_slot(self) -> int:
         best = 0
@@ -116,14 +121,15 @@ class RangeSync:
             self.network.peer_manager.scores.apply_action(
                 pid, PeerAction.LowToleranceError
             )
+            retryable = batch.download_attempts < MAX_BATCH_DOWNLOAD_ATTEMPTS
+            self._count_batch("retried" if retryable else "failed")
             batch.status = (
-                BatchStatus.AwaitingDownload
-                if batch.download_attempts < MAX_BATCH_DOWNLOAD_ATTEMPTS
-                else BatchStatus.Failed
+                BatchStatus.AwaitingDownload if retryable else BatchStatus.Failed
             )
             return
         batch.blocks = blocks
         batch.status = BatchStatus.AwaitingProcessing
+        self._count_batch("downloaded")
 
     async def _process(self, batch: Batch) -> bool:
         """Import the batch's blocks in order; on an invalid block penalize
@@ -142,13 +148,14 @@ class RangeSync:
                     batch.serving_peer, PeerAction.MidToleranceError
                 )
             batch.blocks = []
+            retryable = batch.processing_attempts < MAX_BATCH_PROCESSING_ATTEMPTS
+            self._count_batch("retried" if retryable else "failed")
             batch.status = (
-                BatchStatus.AwaitingDownload
-                if batch.processing_attempts < MAX_BATCH_PROCESSING_ATTEMPTS
-                else BatchStatus.Failed
+                BatchStatus.AwaitingDownload if retryable else BatchStatus.Failed
             )
             return False
         batch.status = BatchStatus.Done
+        self._count_batch("processed")
         return True
 
     async def sync(self) -> SyncResult:
@@ -161,6 +168,15 @@ class RangeSync:
             while True:
                 head_slot = self.chain.fork_choice.get_head().slot
                 target = self._target_slot()
+                if self._metrics:
+                    self._metrics.lodestar.sync_target_slot.set(target)
+                    self._metrics.lodestar.sync_peers.set(
+                        len(
+                            self.network.peer_manager.best_peers(
+                                min_head_slot=head_slot + 1
+                            )
+                        )
+                    )
                 if head_slot >= target and not batches:
                     return SyncResult(self.imported, head_slot, SyncState.Synced)
 

@@ -16,7 +16,12 @@ from lodestar_tpu.crypto.bls.api import PublicKey, Signature, SignatureSet
 
 
 class ModelledDevice:
-    """Latency-modelled fake device (r4 bench: 628 ms @1024, ~1 s @4096)."""
+    """Latency-modelled fake device: a POLICY test double, not kernel
+    evidence.  Constants are fitted to the round-4 builder-session bench
+    (628 ms @1024, ~1 s @4096 end-to-end); the round-5 TPU tunnel was
+    down for the builder session, so no r5 re-fit was possible — re-fit
+    FLOOR_S/PER_SET_S from the next driver-visible bench.py numbers and
+    update this note."""
 
     FLOOR_S = 0.35
     PER_SET_S = 0.00017

@@ -56,10 +56,9 @@ def test_official_operations(fork):
     ran = 0
     for handler, (stem, op_t, apply_fn) in specs.items():
         for suite_dir in _suites(fork, "operations", handler):
-            runner = make_operations_runner(
-                cfg, fork, stem, op_t,
-                lambda cfg_, cached, op, _a=apply_fn: _a(cfg_, cached, op),
-            )
+            # apply_fn passes straight through so its optional `case`
+            # kwarg (execution.yaml engine verdicts) stays visible
+            runner = make_operations_runner(cfg, fork, stem, op_t, apply_fn)
             res = run_directory_spec_test(
                 suite_dir, runner,
                 suite=f"{fork.value}/operations/{handler}",
@@ -122,7 +121,14 @@ def test_official_rewards_and_fork(fork):
             )
             res.assert_ok()
             ran += len(res.passed)
-    for handler in ("basic", "leak", "random"):
+    from lodestar_tpu.params import FORK_SEQ, ForkName as _FN
+
+    rewards_handlers = (
+        ("basic", "leak", "random")
+        if FORK_SEQ[fork] >= FORK_SEQ[_FN.altair]
+        else ()  # phase0 rewards use a different delta layout (inclusion delay)
+    )
+    for handler in rewards_handlers:
         for suite_dir in _suites(fork, "rewards", handler):
             res = run_directory_spec_test(
                 suite_dir, make_rewards_runner(cfg, fork),

@@ -54,10 +54,10 @@ def test_operations_all_handlers(gen_root, fork):
         root = _suite_root(gen_root, fork, "operations", handler)
         if not os.path.isdir(root):
             continue
-        runner = make_operations_runner(
-            cfg, fork, stem, op_t,
-            lambda cfg_, cached, op, _apply=apply_fn: _apply(cfg_, cached, op),
-        )
+        # pass apply_fn straight through — a wrapper would hide the
+        # optional `case` kwarg (execution.yaml engine verdicts) from the
+        # runner's signature check
+        runner = make_operations_runner(cfg, fork, stem, op_t, apply_fn)
         res = run_directory_spec_test(
             root, runner, suite=f"{fork.value}/operations/{handler}"
         )
