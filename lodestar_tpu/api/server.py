@@ -598,11 +598,9 @@ class BeaconRestApiServer:
 
     async def _produce_block(self, slot, randao_reveal, graffiti=""):
         """produceBlockWrapper + produceBlockBody in miniature."""
-        import time as _time
-
         from lodestar_tpu.state_transition import process_slots, state_transition
 
-        _t0 = _time.perf_counter()
+        _t0 = time.perf_counter()
 
         head_state = self.chain.get_head_state()
         pre = head_state.clone()
@@ -685,7 +683,7 @@ class BeaconRestApiServer:
         block.state_root = post.hash_tree_root()
         if self.chain.metrics:
             self.chain.metrics.lodestar.produce_block_seconds.observe(
-                _time.perf_counter() - _t0
+                time.perf_counter() - _t0
             )
         return block
 

@@ -37,6 +37,19 @@ REFERENCE_SETS_PER_JOB = 128
 MAX_SIGNATURE_SETS_PER_JOB = 2048
 MAX_BUFFER_WAIT_MS = 100
 
+# Latency governor (VERDICT r4 #3: cap job width so kernel latency stays
+# inside the gossip budget).  The kernel latency model t(B) = FLOOR +
+# PER_SET*B is the r4 builder-session fit (628 ms @1024, ~1 s @4096 —
+# re-fit from the next driver-visible bench).  A request's worst case is
+# waiting out the in-flight job plus its own, so steady-state width is
+# capped where t(width) <= budget/2; when the backlog exceeds the cap
+# the pool is in overload — every extra request would miss the budget
+# anyway, so it reverts to max-width jobs (throughput-optimal drain).
+LATENCY_BUDGET_S = 1.0
+MODEL_FLOOR_S = 0.35
+MODEL_PER_SET_S = 0.00017
+MIN_JOB_WIDTH = 128
+
 
 @dataclass
 class _BufferedJob:
@@ -85,12 +98,22 @@ class DeviceBlsVerifier:
             return all(verify_signature_set(s) for s in sets)
 
         if opts.batchable and len(sets) <= self._max_sets_per_job:
-            return await self._enqueue(list(sets))
+            # a single wide request would bypass the latency governor
+            # (a buffered job is never split at flush time), so chunk it
+            # to the governed width HERE and AND the chunk results
+            cap = self._steady_width_cap()
+            if len(sets) <= cap:
+                return await self._enqueue(list(sets))
+            chunks = [list(sets[i : i + cap]) for i in range(0, len(sets), cap)]
+            results = await asyncio.gather(*(self._enqueue(c) for c in chunks))
+            return all(results)
 
-        # non-batchable or oversized: dispatch now, chunked to job size
+        # non-batchable or oversized: dispatch now, chunked to the
+        # governed width so these jobs honor the latency budget too
+        cap = self._steady_width_cap()
         results = []
-        for i in range(0, len(sets), self._max_sets_per_job):
-            chunk = list(sets[i : i + self._max_sets_per_job])
+        for i in range(0, len(sets), cap):
+            chunk = list(sets[i : i + cap])
             results.append(await self._run_job([_make_job(chunk)]))
         return all(results)
 
@@ -120,11 +143,31 @@ class DeviceBlsVerifier:
         # over the widest batch the window collects).  The reference
         # flushes at 32 sigs (index.ts:48) because its workers saturate
         # early; the device's throughput grows with width instead.
-        if self._buffer_sigs >= self._max_sets_per_job:
+        if self._buffer_sigs >= self._latency_width_cap():
             self._schedule_flush(0)
         elif self._flush_handle is None:
             self._schedule_flush(MAX_BUFFER_WAIT_MS / 1000)
         return await job.future
+
+    def _steady_width_cap(self) -> int:
+        """Width where t(width) <= LATENCY_BUDGET_S/2 under the fitted
+        latency model (worst case = in-flight job + own job)."""
+        budget_width = int(
+            (LATENCY_BUDGET_S / 2 - MODEL_FLOOR_S) / MODEL_PER_SET_S
+        )
+        # MIN_JOB_WIDTH floors the MODEL-derived width (a degenerate fit
+        # must not trickle tiny jobs) but never overrides an explicitly
+        # smaller pool cap (tests construct 8-set pools)
+        return min(self._max_sets_per_job, max(MIN_JOB_WIDTH, budget_width))
+
+    def _latency_width_cap(self) -> int:
+        """Steady-state governed width — unless the backlog already
+        exceeds what capped jobs can clear in-budget, which is overload:
+        revert to max-width drain (throughput-optimal)."""
+        cap = self._steady_width_cap()
+        if self._buffer_sigs > 2 * cap:
+            return self._max_sets_per_job
+        return cap
 
     def _schedule_flush(self, delay: float) -> None:
         loop = asyncio.get_running_loop()
@@ -141,11 +184,12 @@ class DeviceBlsVerifier:
         self._flush_handle = None
         if not self._buffer or self._inflight:
             return
+        width_cap = self._latency_width_cap()
         pack: List[_BufferedJob] = []
         count = 0
         while self._buffer:
             job = self._buffer[0]
-            if pack and count + len(job.sets) > self._max_sets_per_job:
+            if pack and count + len(job.sets) > width_cap:
                 break
             pack.append(self._buffer.pop(0))
             count += len(job.sets)

@@ -75,3 +75,83 @@ def test_firehose_p99_under_one_second():
     # the window must be packing requests into large jobs, not trickling
     dev = pool._dv
     assert max(dev.jobs) > 100, f"no large jobs formed: {dev.jobs}"
+
+
+def test_latency_governor_caps_job_width():
+    """The width governor (device_pool._latency_width_cap) must keep
+    steady-state jobs at or below the budget-derived width while still
+    reverting to max-width drain under genuine overload."""
+    from lodestar_tpu.chain.bls import device_pool as dp
+
+    pool = DeviceBlsVerifier(_backend=ModelledDevice())
+    budget_width = int(
+        (dp.LATENCY_BUDGET_S / 2 - dp.MODEL_FLOOR_S) / dp.MODEL_PER_SET_S
+    )
+
+    # steady state: cap = budget width
+    pool._buffer_sigs = budget_width // 2
+    assert pool._latency_width_cap() == max(dp.MIN_JOB_WIDTH, budget_width)
+    # overload: backlog can't clear in-budget -> throughput-optimal drain
+    pool._buffer_sigs = 2 * budget_width + 1
+    assert pool._latency_width_cap() == dp.MAX_SIGNATURE_SETS_PER_JOB
+
+
+def test_governed_pool_keeps_jobs_in_budget_at_offered_load():
+    """At ~1,500 sets/s offered load every dispatched job must fit the
+    latency budget: t(width) = FLOOR + PER_SET*width <= budget/2."""
+    from lodestar_tpu.chain.bls import device_pool as dp
+
+    pool = DeviceBlsVerifier(_backend=ModelledDevice())
+    rng = random.Random(11)
+    latencies = []
+
+    async def one_request(n_sets):
+        t0 = time.monotonic()
+        ok = await pool.verify_signature_sets(
+            [_dummy_set()] * n_sets, VerifyOptions(batchable=True)
+        )
+        latencies.append(time.monotonic() - t0)
+        assert ok
+
+    async def go():
+        tasks = []
+        for _ in range(60):
+            tasks.append(asyncio.ensure_future(one_request(rng.randint(1, 50))))
+            await asyncio.sleep(rng.uniform(0.01, 0.05) * 0.7)
+        await asyncio.gather(*tasks)
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())
+
+    budget_width = int(
+        (dp.LATENCY_BUDGET_S / 2 - dp.MODEL_FLOOR_S) / dp.MODEL_PER_SET_S
+    )
+    dev = pool._dv
+    assert dev.jobs, "no jobs dispatched"
+    oversize = [w for w in dev.jobs if w > budget_width]
+    assert not oversize, f"jobs exceeded the governed width: {oversize}"
+    latencies.sort()
+    p99 = latencies[int(0.99 * (len(latencies) - 1))]
+    assert p99 < 1.0, f"p99 {p99:.3f}s over budget with governor active"
+
+
+def test_wide_single_request_is_chunked_to_governed_width():
+    """One 1,500-set batchable request (a full block's signature sets)
+    must not ride through as a single over-budget job — the pool chunks
+    it to the governed width at enqueue."""
+    from lodestar_tpu.chain.bls import device_pool as dp
+
+    pool = DeviceBlsVerifier(_backend=ModelledDevice())
+    cap = pool._steady_width_cap()
+
+    async def go():
+        ok = await pool.verify_signature_sets(
+            [_dummy_set()] * 1500, VerifyOptions(batchable=True)
+        )
+        assert ok
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())
+    dev = pool._dv
+    assert dev.jobs and max(dev.jobs) <= cap, (
+        f"wide request dispatched over the governed width: {dev.jobs}"
+    )
+    assert sum(dev.jobs) == 1500
