@@ -108,12 +108,15 @@ class DeviceBlsVerifier:
             results = await asyncio.gather(*(self._enqueue(c) for c in chunks))
             return all(results)
 
-        # non-batchable or oversized: dispatch now, chunked to the
-        # governed width so these jobs honor the latency budget too
-        cap = self._steady_width_cap()
+        # non-batchable or oversized: dispatch now, chunked to job size.
+        # These chunks run SEQUENTIALLY for this caller, so the governed
+        # width would multiply the ~350 ms per-job floor against the
+        # caller's own latency without protecting anyone else — max-width
+        # chunks amortize the floor instead (the governor protects the
+        # QUEUED path's bystanders).
         results = []
-        for i in range(0, len(sets), cap):
-            chunk = list(sets[i : i + cap])
+        for i in range(0, len(sets), self._max_sets_per_job):
+            chunk = list(sets[i : i + self._max_sets_per_job])
             results.append(await self._run_job([_make_job(chunk)]))
         return all(results)
 
@@ -163,9 +166,12 @@ class DeviceBlsVerifier:
     def _latency_width_cap(self) -> int:
         """Steady-state governed width — unless the backlog already
         exceeds what capped jobs can clear in-budget, which is overload:
-        revert to max-width drain (throughput-optimal)."""
+        revert to max-width drain (throughput-optimal).  The threshold
+        is at least one full max job so a single wide request's chunks
+        (just gathered by verify_signature_sets) cannot flip the pool
+        into overload and re-fuse themselves into one over-budget job."""
         cap = self._steady_width_cap()
-        if self._buffer_sigs > 2 * cap:
+        if self._buffer_sigs > max(2 * cap, self._max_sets_per_job):
             return self._max_sets_per_job
         return cap
 

@@ -17,14 +17,16 @@ from lodestar_tpu.crypto.bls.api import PublicKey, Signature, SignatureSet
 
 class ModelledDevice:
     """Latency-modelled fake device: a POLICY test double, not kernel
-    evidence.  Constants are fitted to the round-4 builder-session bench
-    (628 ms @1024, ~1 s @4096 end-to-end); the round-5 TPU tunnel was
-    down for the builder session, so no r5 re-fit was possible — re-fit
-    FLOOR_S/PER_SET_S from the next driver-visible bench.py numbers and
-    update this note."""
+    evidence.  The constants ARE the pool's governor model
+    (device_pool.MODEL_FLOOR_S/PER_SET_S — one re-fit updates both the
+    governor and this double), fitted to the round-4 builder-session
+    bench (628 ms @1024, ~1 s @4096 end-to-end); the round-5 TPU tunnel
+    was down, so no r5 re-fit was possible."""
 
-    FLOOR_S = 0.35
-    PER_SET_S = 0.00017
+    from lodestar_tpu.chain.bls.device_pool import MODEL_FLOOR_S, MODEL_PER_SET_S
+
+    FLOOR_S = MODEL_FLOOR_S
+    PER_SET_S = MODEL_PER_SET_S
 
     def __init__(self):
         self.jobs = []
@@ -91,8 +93,11 @@ def test_latency_governor_caps_job_width():
     # steady state: cap = budget width
     pool._buffer_sigs = budget_width // 2
     assert pool._latency_width_cap() == max(dp.MIN_JOB_WIDTH, budget_width)
-    # overload: backlog can't clear in-budget -> throughput-optimal drain
-    pool._buffer_sigs = 2 * budget_width + 1
+    # one max-size request's chunks must NOT count as overload
+    pool._buffer_sigs = dp.MAX_SIGNATURE_SETS_PER_JOB
+    assert pool._latency_width_cap() == max(dp.MIN_JOB_WIDTH, budget_width)
+    # genuine overload: backlog beyond one full max job -> max-width drain
+    pool._buffer_sigs = dp.MAX_SIGNATURE_SETS_PER_JOB + 1
     assert pool._latency_width_cap() == dp.MAX_SIGNATURE_SETS_PER_JOB
 
 
