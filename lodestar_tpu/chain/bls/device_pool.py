@@ -108,15 +108,17 @@ class DeviceBlsVerifier:
             results = await asyncio.gather(*(self._enqueue(c) for c in chunks))
             return all(results)
 
-        # non-batchable or oversized: dispatch now, chunked to job size.
-        # These chunks run SEQUENTIALLY for this caller, so the governed
-        # width would multiply the ~350 ms per-job floor against the
-        # caller's own latency without protecting anyone else — max-width
-        # chunks amortize the floor instead (the governor protects the
-        # QUEUED path's bystanders).
+        # non-batchable or oversized: dispatch now, chunked to the
+        # governed width.  All jobs serialize on the device, so a
+        # max-width immediate job would hold queued-path bystanders past
+        # the budget the governor guarantees (worst case = in-flight +
+        # own job, each <= budget/2).  The oversized caller pays the
+        # per-chunk dispatch floor — that is the accepted price of the
+        # bystander guarantee.
+        cap = self._steady_width_cap()
         results = []
-        for i in range(0, len(sets), self._max_sets_per_job):
-            chunk = list(sets[i : i + self._max_sets_per_job])
+        for i in range(0, len(sets), cap):
+            chunk = list(sets[i : i + cap])
             results.append(await self._run_job([_make_job(chunk)]))
         return all(results)
 
@@ -171,7 +173,10 @@ class DeviceBlsVerifier:
         (just gathered by verify_signature_sets) cannot flip the pool
         into overload and re-fuse themselves into one over-budget job."""
         cap = self._steady_width_cap()
-        if self._buffer_sigs > max(2 * cap, self._max_sets_per_job):
+        # threshold: a full max-size request's chunks PLUS a capped job's
+        # worth of bystanders must not count as overload (else the just-
+        # chunked request re-fuses into one over-budget job)
+        if self._buffer_sigs > self._max_sets_per_job + cap:
             return self._max_sets_per_job
         return cap
 

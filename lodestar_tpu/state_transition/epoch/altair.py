@@ -136,8 +136,9 @@ def process_inactivity_updates(cfg, state, proc: AltairEpochProcess) -> None:
         scores[el] = np.maximum(
             0, scores[el] - cfg.INACTIVITY_SCORE_RECOVERY_RATE
         )
-    for i in np.nonzero(el)[0]:
-        state.inactivity_scores[int(i)] = int(scores[i])
+    # bulk write-back (non-eligible entries are unchanged values): one
+    # tracked-list rebuild instead of ~n per-index tracked writes
+    state.inactivity_scores[:] = scores.tolist()
 
 
 def get_flag_index_deltas(cfg, state, proc: AltairEpochProcess, flag_index: int):
@@ -223,8 +224,10 @@ def process_rewards_and_penalties(cfg, state, proc: AltairEpochProcess) -> None:
     rewards, penalties = get_flag_deltas(cfg, state, proc)
     balances = np.array(state.balances, dtype=np.int64)
     balances = np.maximum(0, balances + rewards - penalties)
-    for i, b in enumerate(balances):
-        state.balances[i] = int(b)
+    # bulk write-back: a slice assignment costs ONE incremental-tree
+    # rebuild of the balances subtree (~25 ms native at 250k) instead of
+    # 250k tracked per-index writes (~1.2 s of Python)
+    state.balances[:] = balances.tolist()
     proc.balances = balances
 
 

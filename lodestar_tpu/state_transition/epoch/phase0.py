@@ -268,8 +268,10 @@ def process_rewards_and_penalties(cfg, state, proc: EpochProcess) -> None:
     rewards, penalties = get_attestation_deltas(cfg, state, proc)
     balances = np.array(state.balances, dtype=np.int64)
     balances = np.maximum(0, balances + rewards - penalties)
-    for i, b in enumerate(balances):
-        state.balances[i] = int(b)
+    # bulk write-back: a slice assignment costs ONE incremental-tree
+    # rebuild of the balances subtree (~25 ms native at 250k) instead of
+    # 250k tracked per-index writes (~1.2 s of Python)
+    state.balances[:] = balances.tolist()
     proc.balances = balances
 
 

@@ -93,11 +93,13 @@ def test_latency_governor_caps_job_width():
     # steady state: cap = budget width
     pool._buffer_sigs = budget_width // 2
     assert pool._latency_width_cap() == max(dp.MIN_JOB_WIDTH, budget_width)
-    # one max-size request's chunks must NOT count as overload
-    pool._buffer_sigs = dp.MAX_SIGNATURE_SETS_PER_JOB
-    assert pool._latency_width_cap() == max(dp.MIN_JOB_WIDTH, budget_width)
-    # genuine overload: backlog beyond one full max job -> max-width drain
-    pool._buffer_sigs = dp.MAX_SIGNATURE_SETS_PER_JOB + 1
+    cap = pool._steady_width_cap()
+    # one max-size request's chunks + a capped job's worth of bystanders
+    # must NOT count as overload (re-fusion guard)
+    pool._buffer_sigs = dp.MAX_SIGNATURE_SETS_PER_JOB + cap
+    assert pool._latency_width_cap() == cap
+    # genuine overload: beyond that -> max-width drain
+    pool._buffer_sigs = dp.MAX_SIGNATURE_SETS_PER_JOB + cap + 1
     assert pool._latency_width_cap() == dp.MAX_SIGNATURE_SETS_PER_JOB
 
 
