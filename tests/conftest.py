@@ -80,6 +80,7 @@ _E2E_FILES = {
     "test_checkpoint_sync_and_builder.py",
     "test_discovery_and_merge.py",
     "test_wire_transport.py",
+    "test_dryrun_artifact.py",
     "test_official_vectors.py",
 }
 # correct but minutes-long single-process suites: neither fast nor e2e
