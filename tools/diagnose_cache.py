@@ -45,12 +45,14 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 @jax.jit
 def f(x):
-    for _ in range(20):
-        x = jnp.tanh(x @ x) + jnp.sin(x)
+    # large enough that COMPILE dominates trace overhead — the warm/cold
+    # ratio check needs a compile-bound cold run to be meaningful
+    for i in range(60):
+        x = jnp.tanh(x @ x) + jnp.sin(x) * (1.0 + i)
     return x.sum()
 
 t0 = time.time()
-r = f(jnp.ones((128, 128), jnp.float32))
+r = f(jnp.ones((256, 256), jnp.float32))
 r.block_until_ready()
 print(f"RESULT {float(r):.3f} elapsed {time.time() - t0:.2f}s")
 """
