@@ -20,15 +20,10 @@ n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 if os.environ.get("_LODESTAR_PROFILE_CHILD") != "1":
     import subprocess
 
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))
-    }
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon_site" not in p
-    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from tools.diagnose_cache import scrub_axon_env
+
+    env = scrub_axon_env(os.environ)
     env["_LODESTAR_PROFILE_CHILD"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     env["LODESTAR_TPU_FP_PLATFORM"] = "cpu"
