@@ -1,21 +1,34 @@
 #!/bin/bash
-# End-of-round cache warm-up (VERDICT r3 next #2): run the two driver
-# artifacts + the kernel test files once with the FINAL committed program
-# so their .jax_cache entries are warm in the workdir when the driver
-# fires.  Sequential on purpose — one CPU core.
+# End-of-round cache warm-up: run the two driver artifacts + the kernel
+# test files once with the FINAL committed program so their .jax_cache
+# entries are warm in the workdir when the driver fires.  Sequential on
+# purpose — one CPU core.
+#
+# Round-5 notes:
+#  * dryrun_multichip now SELF-TIME-BOXES (420 s) and falls back to the
+#    reduced sharded step; a warming pass must lift the budget so the
+#    FULL program gets to compile (5+ CPU-hours cold on this host).
+#  * The full program's cache entry does NOT survive cross-process reuse
+#    on this host class (payload fails deserialization while JAX counts
+#    the failed load as a hit — see tools/diagnose_cache.py); the reduced
+#    step's entries DO, and they are what keeps the driver green.
 set -x
 cd "$(dirname "$0")/.."
 
-echo "=== 1/3 CPU multichip dryrun (writes the sharded-program cache entry)"
-time timeout 5400 python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
-echo "dryrun rc=$?"
+echo "=== 1/4 reduced-step dryrun (the entries the driver's fallback uses)"
+time LODESTAR_TPU_DRYRUN_BUDGET_S=5 LODESTAR_TPU_DRYRUN_REDUCED_BUDGET_S=3600 \
+  timeout 3700 python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+echo "reduced dryrun rc=$?"
 
-echo "=== 2/3 TPU bench, full ladder (writes the TPU kernel cache entries)"
+echo "=== 2/4 FULL-program dryrun (optional; hours — proves the full path)"
+time LODESTAR_TPU_DRYRUN_BUDGET_S=28800 \
+  timeout 29000 python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+echo "full dryrun rc=$?"
+
+echo "=== 3/4 TPU bench (writes the TPU kernel cache entries)"
 time BENCH_BUDGET_S=2600 python bench.py
 echo "bench rc=$?"
 
-echo "=== 3/3 kernel test files (CPU cache entries for the suite)"
-time timeout 7200 python -m pytest tests/test_fp_jax.py tests/test_tower_jax.py \
-  tests/test_pairing_jax.py tests/test_fast_aggregate_device.py \
-  tests/test_device_h2c.py -q
+echo "=== 4/4 kernel test files (CPU cache entries for the suite)"
+time timeout 14000 python -m pytest tests/ -m kernel -q
 echo "tests rc=$?"
