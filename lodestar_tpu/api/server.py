@@ -1097,7 +1097,9 @@ class BeaconRestApiServer:
                 topic, data = await queue.get()
                 payload = f"event: {topic}\ndata: {json.dumps(data)}\n\n"
                 await resp.write(payload.encode())
-        except (asyncio.CancelledError, ConnectionResetError):
+        except asyncio.CancelledError:
+            raise  # server shutdown / client gone; aiohttp expects it
+        except ConnectionResetError:
             pass
         finally:
             self._event_queues.remove(entry)

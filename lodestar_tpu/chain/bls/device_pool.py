@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_set
+from lodestar_tpu.utils import gather_settled
 from .interface import VerifyOptions
 from .metrics import BlsPoolMetrics
 
@@ -105,8 +106,12 @@ class DeviceBlsVerifier:
             if len(sets) <= cap:
                 return await self._enqueue(list(sets))
             chunks = [list(sets[i : i + cap]) for i in range(0, len(sets), cap)]
-            results = await asyncio.gather(*(self._enqueue(c) for c in chunks))
-            return all(results)
+            # settle every chunk before reporting, so a failing chunk
+            # can't leave detached siblings with unretrieved exceptions
+            # (ADVICE r5)
+            return all(
+                await gather_settled(*(self._enqueue(c) for c in chunks))
+            )
 
         # non-batchable or oversized: dispatch now, chunked to the
         # governed width.  All jobs serialize on the device, so a

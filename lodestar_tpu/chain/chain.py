@@ -25,6 +25,7 @@ from lodestar_tpu.state_transition.epoch.phase0 import (
 )
 from lodestar_tpu.state_transition.signature_sets import get_block_signature_sets
 from lodestar_tpu.types import ssz
+from lodestar_tpu.utils import gather_settled
 from lodestar_tpu.utils.queue import JobItemQueue, QueueType
 from .bls import BlsVerifier, SingleThreadBlsVerifier, VerifyOptions
 from .clock import LocalClock
@@ -358,7 +359,10 @@ class BeaconChain:
                 )
             return ok
 
-        payload_res, post_state, sigs_ok = await asyncio.gather(
+        # all three branches settle before any error propagates —
+        # otherwise a failing branch would leave the executor STF /
+        # device verify running detached with unretrieved exceptions
+        payload_res, post_state, sigs_ok = await gather_settled(
             verify_payload(),
             loop.run_in_executor(None, run_stf),
             verify_signatures(),

@@ -250,6 +250,9 @@ class UdpEndpoint:
     def __init__(self):
         self._transport = None
         self._receiver: Optional[DatagramReceiver] = None
+        # strong refs: the loop holds tasks weakly, so an unreferenced
+        # datagram-handler task could be GC'd mid-flight
+        self._tasks: Set[asyncio.Task] = set()
 
     async def open(self, host: str, port: int, receiver: DatagramReceiver):
         self._receiver = receiver
@@ -260,9 +263,11 @@ class UdpEndpoint:
         class _Proto(asyncio.DatagramProtocol):
             def datagram_received(self, data, addr):
                 if outer._receiver is not None:
-                    asyncio.ensure_future(
+                    task = asyncio.ensure_future(
                         outer._receiver(f"{addr[0]}:{addr[1]}", data)
                     )
+                    outer._tasks.add(task)
+                    task.add_done_callback(outer._tasks.discard)
 
         self._transport, _ = await loop.create_datagram_endpoint(
             _Proto, local_addr=(host, port)
@@ -450,10 +455,13 @@ class DiscoveryService:
                         ),
                     )
                     for e in candidates
-                )
+                ),
+                # a peer erroring mid-lookup must not detach the sibling
+                # queries; a failed query just contributes no nodes
+                return_exceptions=True,
             )
             queried.update(node_id_of(e) for e in candidates)
-            if not any(results):
+            if not any(r for r in results if not isinstance(r, BaseException)):
                 break
         return self.table.closest(target)
 

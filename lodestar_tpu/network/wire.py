@@ -419,7 +419,7 @@ class WireTransport:
                 await asyncio.sleep(HEARTBEAT_S)
                 self._heartbeat_once()
             except asyncio.CancelledError:
-                return
+                raise
             except Exception:
                 continue
 

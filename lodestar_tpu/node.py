@@ -85,4 +85,4 @@ async def run_node_notifier(
                 delay = seconds_per_slot
             await asyncio.sleep(delay)
     except asyncio.CancelledError:
-        pass
+        raise  # cancellation is the normal shutdown path; let it propagate

@@ -132,6 +132,17 @@ async def retry(
     raise RetryError(retries, last)
 
 
+async def gather_settled(*aws) -> list:
+    """Settle every awaitable, then surface the first failure — a failing
+    child can't leave siblings running detached with unretrieved
+    exceptions (lodelint gather-exceptions).  Results keep input order."""
+    results = await asyncio.gather(*aws, return_exceptions=True)
+    for r in results:
+        if isinstance(r, BaseException):
+            raise r
+    return list(results)
+
+
 # ---------------------------------------------------------------------------
 # bytes/hex helpers (utils/src/bytes.ts)
 # ---------------------------------------------------------------------------

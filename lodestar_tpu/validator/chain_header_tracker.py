@@ -3,6 +3,12 @@
 Reference: packages/validator/src/services/chainHeaderTracker.ts — the VC
 keeps the latest head (slot, root) pushed by the beacon node's event
 stream instead of polling, and duty services read it synchronously.
+
+The subscription runs in a reconnect loop with exponential backoff: an
+SSE disconnect (node restart, proxy idle-timeout) must not silently end
+head tracking for the VC's lifetime (ADVICE r5 — the old one-shot
+subscription fell back to polling forever after the first hiccup).
+Cancellation propagates; stop() is the only way the loop ends.
 """
 from __future__ import annotations
 
@@ -10,16 +16,23 @@ import asyncio
 import json
 from typing import Optional
 
+from lodestar_tpu.utils import Logger
+
+RECONNECT_BACKOFF_MIN_S = 1.0
+RECONNECT_BACKOFF_MAX_S = 30.0
+
 
 class ChainHeaderTracker:
     """Background task consuming /eth/v1/events?topics=head."""
 
-    def __init__(self, base_url: str):
+    def __init__(self, base_url: str, logger: Optional[Logger] = None):
         self.base_url = base_url.rstrip("/")
         self.head_slot: Optional[int] = None
         self.head_root: Optional[bytes] = None
         self._task: Optional[asyncio.Task] = None
         self._session = None
+        self._backoff = RECONNECT_BACKOFF_MIN_S
+        self._log = (logger or Logger("vc")).child("headTracker")
 
     async def start(self) -> None:
         import aiohttp
@@ -27,24 +40,38 @@ class ChainHeaderTracker:
         self._session = aiohttp.ClientSession()
         self._task = asyncio.create_task(self._run())
 
+    async def _subscribe_once(self) -> None:
+        async with self._session.get(
+            self.base_url + "/eth/v1/events",
+            params={"topics": "head"},
+            timeout=None,
+        ) as resp:
+            event = None
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if line.startswith("event:"):
+                    event = line.split(":", 1)[1].strip()
+                elif line.startswith("data:") and event == "head":
+                    data = json.loads(line.split(":", 1)[1])
+                    self.head_slot = int(data["slot"])
+                    self.head_root = bytes.fromhex(data["block"][2:])
+                    # a live stream earns a fresh backoff for the next drop
+                    self._backoff = RECONNECT_BACKOFF_MIN_S
+
     async def _run(self) -> None:
-        try:
-            async with self._session.get(
-                self.base_url + "/eth/v1/events",
-                params={"topics": "head"},
-                timeout=None,
-            ) as resp:
-                event = None
-                async for raw in resp.content:
-                    line = raw.decode().strip()
-                    if line.startswith("event:"):
-                        event = line.split(":", 1)[1].strip()
-                    elif line.startswith("data:") and event == "head":
-                        data = json.loads(line.split(":", 1)[1])
-                        self.head_slot = int(data["slot"])
-                        self.head_root = bytes.fromhex(data["block"][2:])
-        except (asyncio.CancelledError, Exception):
-            pass  # tracker is best-effort; consumers fall back to polling
+        while True:
+            try:
+                await self._subscribe_once()
+                self._log.debug("head event stream ended; reconnecting")
+            except asyncio.CancelledError:
+                raise  # stop() requested — consumers fall back to polling
+            except Exception as e:
+                self._log.warn(
+                    f"head event stream failed: {e!r}; "
+                    f"retrying in {self._backoff:.1f}s"
+                )
+            await asyncio.sleep(self._backoff)
+            self._backoff = min(self._backoff * 2.0, RECONNECT_BACKOFF_MAX_S)
 
     async def stop(self) -> None:
         if self._task is not None:

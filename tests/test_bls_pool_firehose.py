@@ -13,6 +13,7 @@ import time
 
 from lodestar_tpu.chain.bls import DeviceBlsVerifier, VerifyOptions
 from lodestar_tpu.crypto.bls.api import PublicKey, Signature, SignatureSet
+from lodestar_tpu.utils import gather_settled
 
 
 class ModelledDevice:
@@ -47,6 +48,8 @@ def _dummy_set():
     return SignatureSet(PublicKey((1, 2)), b"m" * 32, Signature(((1, 2), (3, 4))))
 
 
+
+
 def test_firehose_p99_under_one_second():
     """Offered load ~2,500 sets/s for ~3 s of simulated gossip bursts."""
     pool = DeviceBlsVerifier(_backend=ModelledDevice())
@@ -67,7 +70,7 @@ def test_firehose_p99_under_one_second():
         for _ in range(100):
             tasks.append(asyncio.ensure_future(one_request(rng.randint(1, 50))))
             await asyncio.sleep(rng.uniform(0.01, 0.05) * 0.6)
-        await asyncio.gather(*tasks)
+        await gather_settled(*tasks)
 
     asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())
 
@@ -125,7 +128,7 @@ def test_governed_pool_keeps_jobs_in_budget_at_offered_load():
         for _ in range(60):
             tasks.append(asyncio.ensure_future(one_request(rng.randint(1, 50))))
             await asyncio.sleep(rng.uniform(0.01, 0.05) * 0.7)
-        await asyncio.gather(*tasks)
+        await gather_settled(*tasks)
 
     asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())
 

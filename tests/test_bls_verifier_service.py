@@ -15,6 +15,7 @@ from lodestar_tpu.chain.bls import (
     VerifyOptions,
 )
 from lodestar_tpu.crypto.bls.api import SecretKey, SignatureSet
+from lodestar_tpu.utils import gather_settled
 
 
 class FakeBackend:
@@ -67,7 +68,7 @@ class TestDevicePool:
     def test_batchable_requests_coalesce_into_one_job(self, pool):
         async def go():
             opts = VerifyOptions(batchable=True)
-            r = await asyncio.gather(
+            r = await gather_settled(
                 *(pool.verify_signature_sets(make_sets(1), opts) for _ in range(5))
             )
             return r
@@ -93,7 +94,7 @@ class TestDevicePool:
 
         async def go():
             opts = VerifyOptions(batchable=True)
-            return await asyncio.gather(
+            return await gather_settled(
                 *(pool.verify_signature_sets(make_sets(1), opts) for _ in range(8))
             )
 
@@ -107,7 +108,7 @@ class TestDevicePool:
             opts = VerifyOptions(batchable=True)
             good = pool.verify_signature_sets(make_sets(2), opts)
             bad = pool.verify_signature_sets(make_sets(1, valid=False), opts)
-            return await asyncio.gather(good, bad)
+            return await gather_settled(good, bad)
 
         res = run(go())
         assert res == [True, False]

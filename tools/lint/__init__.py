@@ -1,0 +1,26 @@
+"""lodelint — repo-specific AST static analysis for lodestar-tpu.
+
+Two recurring defect classes keep coming back in review (ADVICE.md):
+asyncio hazards (swallowed cancellation, detached gather siblings,
+fire-and-forget tasks, event-loop-blocking calls) and JAX hazards
+(retrace-prone jit construction, unhashable static args, host syncs on
+the verify hot path, unsynced timing loops).  This package encodes those
+invariants as mechanical rules and gates them in tier-1.
+
+Usage:
+    python -m tools.lint [paths...]        # human output, exit 1 on findings
+    python -m tools.lint --json [paths...]
+    python -m tools.lint --list-rules
+
+Suppression:  append ``# lodelint: disable=RULE[,RULE...]`` to the
+flagged line (with a reason), or ``# lodelint: disable-file=RULE``
+anywhere in a file.  Grandfathered findings live in
+``tools/lint/baseline.json``.  See docs/LINT.md.
+"""
+from . import core
+from .core import Finding, Rule, RULES, check_source, register, run
+
+# importing the rule modules populates the registry
+from . import rules_async, rules_jax, rules_repo  # noqa: F401  (registration)
+
+__all__ = ["Finding", "Rule", "RULES", "check_source", "register", "run", "core"]

@@ -1,0 +1,87 @@
+"""CLI: ``python -m tools.lint [paths...]`` — exit 0 iff no
+non-baselined findings.  Tier-1 runs the same check via
+tests/test_lodelint.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import tools.lint as lodelint
+from tools.lint import core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="lodelint: async/JAX hazard analyzer for lodestar-tpu",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files/dirs to lint (default: {' '.join(core.DEFAULT_PATHS)})",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument(
+        "--baseline",
+        default=core.DEFAULT_BASELINE,
+        help="baseline file of grandfathered findings",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true", help="report baselined findings too"
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline to grandfather every current finding",
+    )
+    ap.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(lodelint.RULES):
+            print(f"{rule_id}\n    {lodelint.RULES[rule_id].description}\n")
+        return 0
+
+    paths = args.paths or list(core.DEFAULT_PATHS)
+    baseline = None if (args.no_baseline or args.write_baseline) else args.baseline
+    try:
+        findings, baselined = core.run(paths, baseline_path=baseline)
+    except FileNotFoundError as e:
+        print(f"lodelint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        # scoped write: entries for files OUTSIDE the scanned set survive
+        scanned = {core._rel(fp) for fp in core.iter_py_files(paths)}
+        keep = {
+            key: n
+            for key, n in core.load_baseline(args.baseline).items()
+            if key[0] not in scanned
+        }
+        core.write_baseline(findings, args.baseline, keep=keep)
+        kept = f" (kept {sum(keep.values())} out-of-scope)" if keep else ""
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}{kept}")
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_json() for f in findings],
+                    "baselined": baselined,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        tail = f" ({baselined} baselined)" if baselined else ""
+        print(f"lodelint: {len(findings)} finding(s){tail}", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

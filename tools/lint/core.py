@@ -1,0 +1,312 @@
+"""Rule framework: registry, AST helpers, suppressions, baseline, runner.
+
+Design notes
+------------
+* Rules are pure AST passes — no imports of the analyzed code, so the
+  whole repo lints in well under a second (fast-tier friendly).
+* Every AST node gets ``._ll_parent`` / ``._ll_field`` links so rules can
+  ask structural questions ("am I inside an async def's *body*, not its
+  decorator list?") without each rule re-walking the tree.
+* The baseline counts findings per (path, rule) instead of pinning line
+  numbers, so unrelated edits above a grandfathered finding don't churn
+  the file.  New findings beyond the baselined count still fail.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+# what `python -m tools.lint` checks when given no paths (repo-relative)
+DEFAULT_PATHS = (
+    "lodestar_tpu",
+    "tests",
+    "tools",
+    "bench.py",
+    "bench_stf.py",
+    "__graft_entry__.py",
+)
+_SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", "node_modules", ".venv", "csrc"}
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1} [{self.rule}] {self.message}"
+
+    def as_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col + 1,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+class Rule:
+    """One invariant.  Subclass, set ``id``/``description``, implement
+    ``check``; optionally narrow ``applies`` to a path subset."""
+
+    id: str = ""
+    description: str = ""
+
+    def applies(self, path: str) -> bool:
+        return path.endswith(".py")
+
+    def check(self, tree: ast.Module, text: str, path: str) -> List["Finding"]:
+        raise NotImplementedError
+
+    def finding(self, path: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            path=path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            rule=self.id,
+            message=message,
+        )
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    rule = cls()
+    assert rule.id and rule.id not in RULES, f"bad/duplicate rule id {rule.id!r}"
+    RULES[rule.id] = rule
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+def annotate_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for field, value in ast.iter_fields(node):
+            children = value if isinstance(value, list) else [value]
+            for child in children:
+                if isinstance(child, ast.AST):
+                    child._ll_parent = node  # type: ignore[attr-defined]
+                    child._ll_field = field  # type: ignore[attr-defined]
+
+
+def parent_chain(node: ast.AST) -> Iterable[Tuple[ast.AST, ast.AST, str]]:
+    """Yield (child, parent, field_of_child_in_parent) walking to the root."""
+    while True:
+        parent = getattr(node, "_ll_parent", None)
+        if parent is None:
+            return
+        yield node, parent, getattr(node, "_ll_field", "")
+        node = parent
+
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def nearest_function(node: ast.AST) -> Optional[ast.AST]:
+    """Innermost function whose *body* contains node (decorators, default
+    values and annotations belong to the enclosing scope, not the def)."""
+    for child, parent, field in parent_chain(node):
+        if isinstance(parent, _FUNCS) and field == "body":
+            return parent
+    return None
+
+
+def enclosing_loop(node: ast.AST) -> Optional[ast.AST]:
+    """Innermost for/while whose body/orelse contains node, stopping at
+    the first function boundary (a loop outside the def doesn't count)."""
+    for child, parent, field in parent_chain(node):
+        if isinstance(parent, _FUNCS) and field == "body":
+            return None
+        if isinstance(parent, (ast.For, ast.AsyncFor, ast.While)) and field in (
+            "body",
+            "orelse",
+        ):
+            return parent
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'asyncio.gather' for Name/Attribute chains; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lodelint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
+
+
+def parse_suppressions(text: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Directives are read from COMMENT tokens only — a directive spelled
+    inside a string literal (e.g. a lint-test fixture) must not disable
+    anything for the real file containing it."""
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    try:
+        comments = [
+            (tok.start[0], tok.string)
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline)
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, per_file  # unparseable source is a parse-error finding
+    for lineno, comment in comments:
+        m = _SUPPRESS_RE.search(comment)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+        if m.group(1) == "disable-file":
+            per_file |= rules
+        else:
+            per_line.setdefault(lineno, set()).update(rules)
+    return per_line, per_file
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def check_source(
+    text: str, path: str, rule_ids: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Lint one source string.  ``path`` is repo-relative and drives
+    per-rule ``applies`` scoping (tests pass synthetic paths)."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [
+            Finding(path=path, line=e.lineno or 1, col=0, rule="parse-error",
+                    message=f"could not parse: {e.msg}")
+        ]
+    annotate_parents(tree)
+    per_line, per_file = parse_suppressions(text)
+    rules = (
+        [RULES[r] for r in rule_ids] if rule_ids is not None else list(RULES.values())
+    )
+    findings: List[Finding] = []
+    for rule in rules:
+        if not rule.applies(path):
+            continue
+        for f in rule.check(tree, text, path):
+            if f.rule in per_file or f.rule in per_line.get(f.line, set()):
+                continue
+            findings.append(f)
+    return sorted(findings)
+
+
+def _rel(path: str) -> str:
+    ap = os.path.abspath(path)
+    if ap.startswith(REPO_ROOT + os.sep):
+        ap = os.path.relpath(ap, REPO_ROOT)
+    return ap.replace(os.sep, "/")
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        root = p if os.path.isabs(p) else os.path.join(REPO_ROOT, p)
+        if os.path.isdir(root):
+            found = False
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        found = True
+                        yield os.path.join(dirpath, fn)
+            if found:
+                continue
+        elif root.endswith(".py") and os.path.exists(root):
+            yield root
+            continue
+        # a typo'd/renamed/emptied CI target must not lint nothing and
+        # stay green forever
+        raise FileNotFoundError(f"lint path matched no Python files: {p}")
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str], int]:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: Dict[Tuple[str, str], int] = {}
+    for e in data.get("entries", []):
+        out[(e["path"], e["rule"])] = int(e.get("count", 1))
+    return out
+
+
+def write_baseline(
+    findings: Sequence[Finding],
+    path: str,
+    keep: Optional[Dict[Tuple[str, str], int]] = None,
+) -> None:
+    """``keep`` carries existing entries to preserve — a scoped
+    ``--write-baseline a.py`` must not discard other files' grandfathered
+    findings."""
+    counts: Dict[Tuple[str, str], int] = dict(keep or {})
+    for f in findings:
+        counts[(f.path, f.rule)] = counts.get((f.path, f.rule), 0) + 1
+    entries = [
+        {"path": p, "rule": r, "count": n} for (p, r), n in sorted(counts.items())
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def run(
+    paths: Sequence[str],
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+) -> Tuple[List[Finding], int]:
+    """Lint files; returns (non-baselined findings, baselined count).
+
+    Baselined findings are matched per (path, rule) in line order, so a
+    grandfathered file fails again only when it grows NEW findings."""
+    all_findings: List[Finding] = []
+    for fp in iter_py_files(paths):
+        with open(fp, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        all_findings.extend(check_source(text, _rel(fp)))
+    budget = dict(load_baseline(baseline_path) if baseline_path else {})
+    fresh: List[Finding] = []
+    baselined = 0
+    for f in sorted(all_findings):
+        key = (f.path, f.rule)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            baselined += 1
+        else:
+            fresh.append(f)
+    return fresh, baselined

@@ -1,0 +1,272 @@
+"""Async-hazard rules: the defect class behind ADVICE.md's
+chain_header_tracker / device_pool findings.
+
+cancellation semantics recap (py>=3.8): CancelledError subclasses
+BaseException, so ``except Exception`` does NOT catch it — only bare
+``except``, ``except BaseException`` and explicit CancelledError
+handlers do, and those must re-raise or task cancellation dies there.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import (
+    Finding,
+    Rule,
+    dotted_name,
+    nearest_function,
+    register,
+    unparse,
+)
+
+_CANCEL_TYPES = {"CancelledError", "asyncio.CancelledError", "BaseException"}
+_TASK_FACTORIES = {"create_task", "ensure_future"}
+
+
+def _handler_catches_cancel(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:  # bare except
+        return True
+    types = (
+        handler.type.elts if isinstance(handler.type, ast.Tuple) else [handler.type]
+    )
+    return any(dotted_name(t) in _CANCEL_TYPES for t in types)
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            if node.exc is None:
+                return True
+            exc = node.exc
+            # `except CancelledError as e: ...; raise e` propagates too
+            if (
+                isinstance(exc, ast.Name)
+                and handler.name
+                and exc.id == handler.name
+            ):
+                return True
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            if dotted_name(exc) in (_CANCEL_TYPES - {"BaseException"}):
+                return True
+    return False
+
+
+def _awaits_own_cancelled_task(try_node: ast.Try, func: Optional[ast.AST]) -> bool:
+    """The stop() idiom — ``t.cancel(); try: await t; except CancelledError:
+    pass`` — is the one place swallowing is correct: the function itself
+    requested the cancellation and the expected outcome is "task ended"."""
+    if func is None:
+        return False
+    awaited: Set[str] = set()
+    for stmt in try_node.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Await):
+                awaited.add(unparse(node.value))
+    if not awaited:
+        return False
+    for node in ast.walk(func):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "cancel"
+            and unparse(node.func.value) in awaited
+        ):
+            return True
+    return False
+
+
+@register
+class SwallowedCancel(Rule):
+    id = "swallowed-cancel"
+    description = (
+        "except clause inside async def catches asyncio.CancelledError "
+        "(explicitly, via BaseException, or bare except) without re-raising: "
+        "task cancellation is silently absorbed and stop()/shutdown hangs or "
+        "the coroutine keeps running"
+    )
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            func = nearest_function(node)
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            for handler in node.handlers:
+                if not _handler_catches_cancel(handler):
+                    continue
+                if _handler_reraises(handler):
+                    continue
+                if _awaits_own_cancelled_task(node, func):
+                    continue
+                out.append(
+                    self.finding(
+                        path,
+                        handler,
+                        "except clause swallows asyncio.CancelledError; "
+                        "re-raise it (catch Exception for errors, let "
+                        "cancellation propagate)",
+                    )
+                )
+        return out
+
+
+@register
+class GatherNoReturnExceptions(Rule):
+    id = "gather-exceptions"
+    description = (
+        "asyncio.gather fan-out without return_exceptions: the first "
+        "failing child propagates immediately while sibling awaitables "
+        "keep running detached and their exceptions go unretrieved"
+    )
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn not in ("asyncio.gather", "gather"):
+                continue
+            fan_out = len(node.args) >= 2 or any(
+                isinstance(a, ast.Starred) for a in node.args
+            )
+            if not fan_out:
+                continue
+            re_kw = next(
+                (kw for kw in node.keywords if kw.arg == "return_exceptions"), None
+            )
+            # a spelled-out return_exceptions=False is the hazard, not a
+            # mitigation; a non-constant value gets the benefit of the doubt
+            if re_kw is not None and not (
+                isinstance(re_kw.value, ast.Constant) and re_kw.value.value is False
+            ):
+                continue
+            out.append(
+                self.finding(
+                    path,
+                    node,
+                    "gather fan-out without return_exceptions=True; pass it "
+                    "and fold the results so no sibling is left detached",
+                )
+            )
+        return out
+
+
+@register
+class TaskNoRef(Rule):
+    id = "task-no-ref"
+    description = (
+        "fire-and-forget create_task/ensure_future: the event loop holds "
+        "tasks weakly, so an unreferenced task can be garbage-collected "
+        "mid-flight and its exceptions are never retrieved"
+    )
+
+    def _is_factory_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr in _TASK_FACTORIES
+        if isinstance(node.func, ast.Name):
+            return node.func.id in _TASK_FACTORIES
+        return False
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        msg = (
+            "task reference discarded; retain it (e.g. a task set with "
+            "add_done_callback(set.discard)) or await it"
+        )
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Expr) and self._is_factory_call(node.value):
+                out.append(self.finding(path, node, msg))
+            elif (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_"
+                and self._is_factory_call(node.value)
+            ):
+                out.append(self.finding(path, node, msg))
+        return out
+
+
+_BLOCKING_CALLS = {
+    "time.sleep": "await asyncio.sleep(...)",
+    "requests.get": "an async client or run_in_executor",
+    "requests.post": "an async client or run_in_executor",
+    "requests.put": "an async client or run_in_executor",
+    "requests.delete": "an async client or run_in_executor",
+    "requests.head": "an async client or run_in_executor",
+    "requests.request": "an async client or run_in_executor",
+    "urllib.request.urlopen": "an async client or run_in_executor",
+    "subprocess.run": "asyncio.create_subprocess_exec",
+    "subprocess.call": "asyncio.create_subprocess_exec",
+    "subprocess.check_call": "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "asyncio.create_subprocess_exec",
+    "socket.create_connection": "asyncio.open_connection",
+    "socket.getaddrinfo": "loop.getaddrinfo",
+}
+
+
+def _import_aliases(tree: ast.Module) -> dict:
+    """Local name -> canonical dotted prefix, so `from time import sleep`
+    and `import time as t` still resolve to time.sleep."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+@register
+class BlockingAsync(Rule):
+    id = "blocking-async"
+    description = (
+        "synchronous blocking call (time.sleep, sync HTTP, subprocess, "
+        "file open) inside async def stalls the whole event loop — every "
+        "other task, heartbeat and gossip handler waits behind it"
+    )
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        aliases = _import_aliases(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(nearest_function(node), ast.AsyncFunctionDef):
+                continue
+            dn = dotted_name(node.func)
+            if dn:
+                head, _, rest = dn.partition(".")
+                full = aliases.get(head)
+                if full:
+                    dn = full + ("." + rest if rest else "")
+            if dn in _BLOCKING_CALLS:
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        f"{dn}() blocks the event loop inside async def; "
+                        f"use {_BLOCKING_CALLS[dn]}",
+                    )
+                )
+            elif isinstance(node.func, ast.Name) and node.func.id == "open":
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        "open() does blocking file IO inside async def; "
+                        "use run_in_executor (or accept it knowingly with a "
+                        "suppression)",
+                    )
+                )
+        return out

@@ -1,0 +1,343 @@
+"""JAX-hazard rules.  On this repo one avoidable retrace of the pairing
+program costs ~15 min of XLA:CPU compile (see MEMORY/ROADMAP), so jit
+construction discipline and device/host boundaries are gated, not
+reviewed by hand.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    Finding,
+    Rule,
+    dotted_name,
+    enclosing_loop,
+    nearest_function,
+    register,
+)
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_MEMO_DECORATORS = {
+    "lru_cache",
+    "functools.lru_cache",
+    "cache",
+    "functools.cache",
+}
+# files where a hidden device->host sync is a hot-path stall, not a
+# boundary: the batched verify kernels and everything feeding them
+_HOT_PATH_PREFIXES = (
+    "lodestar_tpu/ops/",
+    "lodestar_tpu/chain/bls/",
+    "lodestar_tpu/crypto/bls/",
+)
+_UNHASHABLE = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+    ast.GeneratorExp,
+)
+
+
+def _is_jit_construction(node: ast.Call) -> bool:
+    dn = dotted_name(node.func)
+    if dn in _JIT_NAMES:
+        return True
+    if dn in ("partial", "functools.partial") and node.args:
+        return dotted_name(node.args[0]) in _JIT_NAMES
+    return False
+
+
+def _has_memo_decorator(func: ast.AST) -> bool:
+    for dec in getattr(func, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if dotted_name(target) in _MEMO_DECORATORS:
+            return True
+    return False
+
+
+@register
+class JitInFunc(Rule):
+    id = "jit-in-func"
+    description = (
+        "jax.jit / partial(jax.jit, ...) constructed inside a function or "
+        "loop body: every evaluation builds a fresh jitted callable with an "
+        "empty trace cache, so each call recompiles (~15 min/kernel on this "
+        "host).  Hoist to module level, decorate, or memoize the factory"
+    )
+
+    def applies(self, path: str) -> bool:
+        # test functions run once per process, so constructing the jit
+        # inside them is single-use by design — the retrace hazard this
+        # rule gates is jit construction in long-lived service code
+        return path.endswith(".py") and not path.startswith("tests/")
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_jit_construction(node)):
+                continue
+            func = nearest_function(node)
+            in_loop = enclosing_loop(node) is not None
+            if func is None and not in_loop:
+                continue  # plain module-level construction: compiled once
+            if func is not None and _has_memo_decorator(func) and not in_loop:
+                continue  # memoized factory: one construction per cache key
+            where = "a loop" if in_loop else "a function"
+            out.append(
+                self.finding(
+                    path,
+                    node,
+                    f"jit constructed inside {where}; hoist to module level "
+                    "or wrap the factory in functools.lru_cache",
+                )
+            )
+        return out
+
+
+def _static_positions(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """static_argnums / static_argnames literals of a jit(...) call."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        vals = (
+            kw.value.elts
+            if isinstance(kw.value, (ast.Tuple, ast.List))
+            else [kw.value]
+        )
+        if kw.arg == "static_argnums":
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums.add(v.value)
+        elif kw.arg == "static_argnames":
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+    return nums, names
+
+
+@register
+class StaticUnhashable(Rule):
+    id = "static-unhashable"
+    description = (
+        "a list/dict/set/generator passed in a static_argnums/static_argnames "
+        "position of a jitted function: static args are hashed for the trace "
+        "cache key, so unhashable values raise at call time (and mutable ones "
+        "would silently defeat caching)"
+    )
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        jitted: Dict[str, Tuple[Set[int], Set[str]]] = {}
+
+        # name = jax.jit(fn, static_argnums=...)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _is_jit_construction(node.value)
+            ):
+                nums, names = _static_positions(node.value)
+                if nums or names:
+                    jitted[node.targets[0].id] = (nums, names)
+            # @partial(jax.jit, static_argnames=...) / @jax.jit(...) def f
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _is_jit_construction(dec):
+                        nums, names = _static_positions(dec)
+                        if nums or names:
+                            jitted[node.name] = (nums, names)
+
+        def check_call(call: ast.Call, nums: Set[int], names: Set[str]) -> None:
+            for i, arg in enumerate(call.args):
+                if i in nums and isinstance(arg, _UNHASHABLE):
+                    out.append(
+                        self.finding(
+                            path,
+                            arg,
+                            f"unhashable value in static position {i}; pass a "
+                            "tuple/frozenset or make the arg dynamic",
+                        )
+                    )
+            for kw in call.keywords:
+                if kw.arg in names and isinstance(kw.value, _UNHASHABLE):
+                    out.append(
+                        self.finding(
+                            path,
+                            kw.value,
+                            f"unhashable value for static arg {kw.arg!r}; pass "
+                            "a tuple/frozenset or make the arg dynamic",
+                        )
+                    )
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id in jitted:
+                check_call(node, *jitted[node.func.id])
+            elif isinstance(node.func, ast.Call) and _is_jit_construction(node.func):
+                # immediate jax.jit(f, static_argnums=...)(args) invocation
+                check_call(node, *_static_positions(node.func))
+        return out
+
+
+def _is_device_producer(node: ast.AST, aliases: Set[str]) -> bool:
+    """A call that yields a device value: jnp./jax. ops, *_jit_* entries,
+    or a local alias of one."""
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func) or ""
+    last = dn.rsplit(".", 1)[-1]
+    return (
+        dn.startswith("jnp.")
+        or dn.startswith("jax.")
+        or last.startswith("_jit_")
+        or dn in aliases
+    )
+
+
+def _device_taint(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(names aliasing jitted callables, names assigned device values).
+    File-scoped on purpose: hot-path modules are small and a cross-scope
+    false positive is a one-line suppression with a reason."""
+    aliases: Set[str] = set()
+    tainted: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            continue
+        name = node.targets[0].id
+        vdn = dotted_name(node.value) or ""
+        if vdn.rsplit(".", 1)[-1].startswith("_jit_"):
+            aliases.add(name)  # fn = dv._jit_hashed
+        elif _is_device_producer(node.value, aliases):
+            tainted.add(name)
+    return aliases, tainted
+
+
+@register
+class HostSync(Rule):
+    id = "host-sync"
+    description = (
+        "device->host sync (float()/int()/bool()/np.asarray/.tolist()/"
+        ".item() on a device value) inside a verify hot-path file: blocks "
+        "on the device mid-pipeline.  Keep values on device; the one "
+        "deliberate API-boundary sync gets an inline suppression + reason"
+    )
+
+    def applies(self, path: str) -> bool:
+        return path.endswith(".py") and path.startswith(_HOT_PATH_PREFIXES)
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        aliases, tainted = _device_taint(tree)
+
+        def is_device_value(node: ast.AST) -> bool:
+            if isinstance(node, ast.Name) and node.id in tainted:
+                return True
+            return _is_device_producer(node, aliases)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("tolist", "item")
+                and not node.args
+            ):
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        f".{node.func.attr}() forces a device->host transfer",
+                    )
+                )
+                continue
+            dn = dotted_name(node.func)
+            is_cast = isinstance(node.func, ast.Name) and node.func.id in (
+                "float",
+                "int",
+                "bool",
+            )
+            is_np_pull = dn in (
+                "np.asarray",
+                "np.array",
+                "numpy.asarray",
+                "numpy.array",
+            )
+            if (
+                (is_cast or is_np_pull)
+                and len(node.args) >= 1
+                and is_device_value(node.args[0])
+            ):
+                what = dn or node.func.id  # type: ignore[union-attr]
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        f"{what}(...) on a device value synchronously pulls "
+                        "it to host",
+                    )
+                )
+        return out
+
+
+_TIMING_CALLS = {"time.perf_counter", "time.monotonic", "time.time"}
+
+
+@register
+class BenchSync(Rule):
+    id = "bench-sync"
+    description = (
+        "timing loop in a bench file calls device work but never "
+        "block_until_ready: JAX dispatch is async, so the clock measures "
+        "enqueue latency, not the kernel"
+    )
+
+    def applies(self, path: str) -> bool:
+        return os.path.basename(path).startswith("bench") and path.endswith(".py")
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        aliases, _ = _device_taint(tree)
+        funcs = [
+            n
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for func in funcs:
+            timing = 0
+            device = False
+            synced = False
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func) or ""
+                if dn in _TIMING_CALLS:
+                    timing += 1
+                if _is_device_producer(node, aliases):
+                    device = True
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                ) or dn == "jax.block_until_ready":
+                    synced = True
+            if timing >= 2 and device and not synced:
+                out.append(
+                    self.finding(
+                        path,
+                        func,
+                        f"{func.name}() times device calls without "
+                        "block_until_ready on the result",
+                    )
+                )
+        return out

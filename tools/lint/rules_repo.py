@@ -1,0 +1,209 @@
+"""Repo-process rules: each one mechanizes a defect the round-5 advisor
+found by hand (ADVICE.md) so the pattern can't quietly return.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, Rule, dotted_name, parent_chain, register, unparse
+
+
+def _is_elif(child: ast.AST, parent: ast.If) -> bool:
+    """`elif X:` parses as an If that is the sole statement of its
+    parent's orelse AND starts at the parent's column; `else:\\n    if X:`
+    is indented deeper (or has siblings)."""
+    return (
+        isinstance(child, ast.If)
+        and len(parent.orelse) == 1
+        and parent.orelse[0] is child
+        and child.col_offset == parent.col_offset
+    )
+
+
+@register
+class FastTierDefault(Rule):
+    id = "fast-tier-default"
+    description = (
+        "pytest.mark.fast applied on a fallthrough branch of "
+        "pytest_collection_modifyitems: a new (possibly compile-heavy) test "
+        "file that nobody listed silently lands in tier-1.  Fast must be "
+        "explicit opt-in"
+    )
+
+    def _marks_fast(self, call: ast.Call) -> bool:
+        if not (
+            isinstance(call.func, ast.Attribute) and call.func.attr == "add_marker"
+        ):
+            return False
+        for arg in call.args:
+            for node in ast.walk(arg):
+                if isinstance(node, ast.Attribute) and node.attr == "fast":
+                    dn = dotted_name(node) or ""
+                    if ".mark." in dn or dn.startswith("mark."):
+                        return True
+        return False
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and self._marks_fast(node)):
+                continue
+            # walk the FULL chain of enclosing Ifs up to the function
+            # boundary: an explicit `elif name in _FAST_FILES` chain is
+            # opt-in, but a bare else is a fallthrough even when it hides
+            # the marking behind an inner `if` of its own
+            governed = False
+            flagged = False
+            for child, parent, field in parent_chain(node):
+                if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break
+                if not isinstance(parent, ast.If):
+                    continue
+                governed = True
+                if field == "body":
+                    negated = any(
+                        isinstance(op, ast.NotIn)
+                        for cmp_ in ast.walk(parent.test)
+                        if isinstance(cmp_, ast.Compare)
+                        for op in cmp_.ops
+                    )
+                    if negated:
+                        flagged = True
+                        break
+                    continue  # a gated branch; keep looking for an outer else
+                if field == "orelse" and not _is_elif(child, parent):
+                    # a true bare else (an elif shares its parent's column
+                    # and is the orelse's sole statement)
+                    flagged = True
+                    break
+            if flagged:
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        "fast tier assigned by fallthrough (else / 'not in' "
+                        "guard); require explicit membership in a fast list",
+                    )
+                )
+            elif not governed:
+                # no If at all: every collected item is marked fast — the
+                # limiting case of the fallthrough hazard
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        "fast tier assigned unconditionally; require "
+                        "explicit membership in a fast list",
+                    )
+                )
+        return out
+
+
+def _aggregate_arg(node: ast.AST, aggregated: Dict[str, str]) -> Optional[str]:
+    """The iterable expression a min(xs)/max(xs) aggregates over (unparsed),
+    for a direct call or a name bound to one; None if not an aggregate."""
+    if isinstance(node, ast.Name):
+        return aggregated.get(node.id)
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in ("min", "max")
+        and len(node.args) == 1
+        and not node.keywords
+    ):
+        return unparse(node.args[0])
+    return None
+
+
+@register
+class MinMinSub(Rule):
+    id = "min-min-sub"
+    description = (
+        "subtracting two min()/max() aggregates taken over different sample "
+        "lists: the minima come from different iterations, so the difference "
+        "can go negative or understate the phase (bench_stf htr_ms defect). "
+        "Time the phase directly per iteration instead"
+    )
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        aggregated: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                arg = _aggregate_arg(node.value, {})
+                if arg is not None:
+                    aggregated[node.targets[0].id] = arg
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+                continue
+            left = _aggregate_arg(node.left, aggregated)
+            right = _aggregate_arg(node.right, aggregated)
+            # same sample list on both sides (max(xs) - min(xs): a spread)
+            # mixes nothing — only cross-list differences are the hazard
+            if left is not None and right is not None and left != right:
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        "difference of per-list minima/maxima mixes "
+                        "iterations; measure this phase with its own timer",
+                    )
+                )
+        return out
+
+
+_SIGN_OPS = (ast.Lt, ast.Gt, ast.LtE, ast.GtE)
+
+
+@register
+class RcSignTest(Rule):
+    id = "rc-sign-test"
+    description = (
+        "sign comparison (rc < 0 / rc > 0) on a subprocess returncode: "
+        "lumps every signal death into one class, so a NEW crash signature "
+        "rides an existing fallback and is masked.  Compare -rc against an "
+        "explicit set of expected signals"
+    )
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        rc_names: Set[str] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "returncode"
+            ):
+                rc_names.add(node.targets[0].id)
+
+        def is_rc(n: ast.AST) -> bool:
+            if isinstance(n, ast.Attribute) and n.attr == "returncode":
+                return True
+            return isinstance(n, ast.Name) and n.id in rc_names
+
+        def is_zero(n: ast.AST) -> bool:
+            return isinstance(n, ast.Constant) and n.value == 0
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+                continue
+            if not isinstance(node.ops[0], _SIGN_OPS):
+                continue
+            left, right = node.left, node.comparators[0]
+            if (is_rc(left) and is_zero(right)) or (is_zero(left) and is_rc(right)):
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        "returncode sign test hides which signal killed the "
+                        "child; branch on an explicit signal set instead",
+                    )
+                )
+        return out

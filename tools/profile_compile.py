@@ -74,7 +74,7 @@ def main():
         raise SystemExit(f"unknown component {comp}")
 
     t0 = time.time()
-    jfn = jax.jit(fn)
+    jfn = jax.jit(fn)  # lodelint: disable=jit-in-func — one-shot profiler, compiled once
     traced = jfn.trace(*args)
     t1 = time.time()
     lowered = traced.lower()
