@@ -3,10 +3,9 @@
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
-import aiohttp
-
+from lodestar_tpu.execution.http_session import ReusedClientSession
 from lodestar_tpu.ssz.json import from_json, to_json
 from lodestar_tpu.types import ssz
 
@@ -17,19 +16,9 @@ class ApiError(Exception):
         self.status = status
 
 
-class ApiClient:
+class ApiClient(ReusedClientSession):
     def __init__(self, base_url: str):
         self.base_url = base_url.rstrip("/")
-        self._session: Optional[aiohttp.ClientSession] = None
-
-    async def _ses(self) -> aiohttp.ClientSession:
-        if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession()
-        return self._session
-
-    async def close(self) -> None:
-        if self._session and not self._session.closed:
-            await self._session.close()
 
     async def _get(self, path: str, **params):
         ses = await self._ses()

@@ -1183,6 +1183,11 @@ class BeaconRestApiServer:
     async def close(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+        # HttpBuilderApi keeps a reused aiohttp session; release it with
+        # the server (MockBuilder / no-builder configs have no close)
+        builder_close = getattr(self.builder, "close", None)
+        if builder_close is not None:
+            await builder_close()
 
 
     # ------------------------------------------------------------------

@@ -543,6 +543,11 @@ class BeaconChain:
     async def close(self) -> None:
         self.block_queue.abort()
         await self.bls.close()
+        # HttpExecutionEngine keeps a reused aiohttp session; release it
+        # with the chain so shutdown doesn't leak the connector FD
+        eng_close = getattr(self.execution_engine, "close", None)
+        if eng_close is not None:
+            await eng_close()
 
 
 def _genesis_signed_block(anchor_hdr, anchor_state):

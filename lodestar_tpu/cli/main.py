@@ -550,41 +550,54 @@ def run_validator(args) -> int:
     sks = interop_secret_keys(count)[int(lo) :]
 
     async def run():
+        # close the REST session and SSE tracker on every exit path (an
+        # ApiError mid-slot otherwise leaks both, and the node side then
+        # waits out aiohttp's shutdown grace on the dead connections)
         api = ApiClient(args.beacon_url)
-        genesis0 = await api.get_genesis()
-        gvr = bytes.fromhex(genesis0["genesis_validators_root"][2:])
-        store = ValidatorStore(sks, ForkConfig(cfg), gvr)
-        from lodestar_tpu.validator.chain_header_tracker import ChainHeaderTracker
+        tracker = None
+        try:
+            genesis0 = await api.get_genesis()
+            gvr = bytes.fromhex(genesis0["genesis_validators_root"][2:])
+            store = ValidatorStore(sks, ForkConfig(cfg), gvr)
+            from lodestar_tpu.validator.chain_header_tracker import (
+                ChainHeaderTracker,
+            )
 
-        tracker = ChainHeaderTracker(args.beacon_url)
-        await tracker.start()
-        v = Validator(api, store, header_tracker=tracker)
-        await v.initialize()
-        print(
-            f"validator client: {len(sks)} keys -> {args.beacon_url}", flush=True
-        )
-        genesis_time = int(genesis0["genesis_time"])
-        slot = 0
-        while args.slots is None or slot < args.slots:
-            slot += 1
-            target = genesis_time + slot * cfg.SECONDS_PER_SLOT
-            while time.time() < target:
-                await asyncio.sleep(0.1)
-            await v.run_slot(slot)
+            tracker = ChainHeaderTracker(args.beacon_url)
+            await tracker.start()
+            v = Validator(api, store, header_tracker=tracker)
+            await v.initialize()
             print(
-                json.dumps(
-                    {
-                        "slot": slot,
-                        "proposed": v.produced_blocks,
-                        "attested": v.produced_attestations,
-                        "aggregated": v.produced_aggregates,
-                        "sync_messages": v.produced_sync_messages,
-                        "sync_contributions": v.produced_sync_contributions,
-                    }
-                ),
+                f"validator client: {len(sks)} keys -> {args.beacon_url}",
                 flush=True,
             )
-        await tracker.stop()
+            genesis_time = int(genesis0["genesis_time"])
+            slot = 0
+            while args.slots is None or slot < args.slots:
+                slot += 1
+                target = genesis_time + slot * cfg.SECONDS_PER_SLOT
+                while time.time() < target:
+                    await asyncio.sleep(0.1)
+                await v.run_slot(slot)
+                print(
+                    json.dumps(
+                        {
+                            "slot": slot,
+                            "proposed": v.produced_blocks,
+                            "attested": v.produced_attestations,
+                            "aggregated": v.produced_aggregates,
+                            "sync_messages": v.produced_sync_messages,
+                            "sync_contributions": v.produced_sync_contributions,
+                        }
+                    ),
+                    flush=True,
+                )
+        finally:
+            try:
+                if tracker is not None:
+                    await tracker.stop()
+            finally:
+                await api.close()
 
     asyncio.run(run())
     return 0
@@ -603,53 +616,56 @@ def run_lightclient(args) -> int:
 
     async def run():
         api = ApiClient(args.beacon_url)
-        genesis = await api.get_genesis()
-        gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
-        if args.checkpoint_root:
-            root = bytes.fromhex(args.checkpoint_root.replace("0x", ""))
-        else:
-            cp = await api.get_json(
-                "/eth/v1/beacon/states/head/finality_checkpoints"
-            )
-            root = bytes.fromhex(cp["finalized"]["root"][2:])
-            if root == b"\x00" * 32:
-                hdr = await api.get_json("/eth/v1/beacon/headers/head")
-                root = bytes.fromhex(hdr["root"][2:])
-        bs_json = await api.get_json(
-            f"/eth/v1/beacon/light_client/bootstrap/0x{root.hex()}"
-        )
-        bootstrap = from_json(ssz.altair.LightClientBootstrap, bs_json)
-        lc = LightClient.initialize_from_checkpoint_root(cfg, gvr, root, bootstrap)
-        print(
-            f"light client bootstrapped at slot {lc.store.finalized_header.slot}",
-            flush=True,
-        )
-        processed = 0
-        seen_sigs = set()
-        while processed < args.updates:
-            try:
-                fu_json = await api.get_json(
-                    "/eth/v1/beacon/light_client/finality_update"
+        try:
+            genesis = await api.get_genesis()
+            gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+            if args.checkpoint_root:
+                root = bytes.fromhex(args.checkpoint_root.replace("0x", ""))
+            else:
+                cp = await api.get_json(
+                    "/eth/v1/beacon/states/head/finality_checkpoints"
                 )
-                fu = from_json(ssz.altair.LightClientFinalityUpdate, fu_json)
-                key = (fu.signature_slot, fu.attested_header.slot)
-                if key not in seen_sigs:
-                    seen_sigs.add(key)
-                    lc.process_finality_update(fu)
-                    processed += 1
-                    print(
-                        json.dumps(
-                            {
-                                "finalized_slot": lc.store.finalized_header.slot,
-                                "optimistic_slot": lc.store.optimistic_header.slot,
-                            }
-                        ),
-                        flush=True,
+                root = bytes.fromhex(cp["finalized"]["root"][2:])
+                if root == b"\x00" * 32:
+                    hdr = await api.get_json("/eth/v1/beacon/headers/head")
+                    root = bytes.fromhex(hdr["root"][2:])
+            bs_json = await api.get_json(
+                f"/eth/v1/beacon/light_client/bootstrap/0x{root.hex()}"
+            )
+            bootstrap = from_json(ssz.altair.LightClientBootstrap, bs_json)
+            lc = LightClient.initialize_from_checkpoint_root(cfg, gvr, root, bootstrap)
+            print(
+                f"light client bootstrapped at slot {lc.store.finalized_header.slot}",
+                flush=True,
+            )
+            processed = 0
+            seen_sigs = set()
+            while processed < args.updates:
+                try:
+                    fu_json = await api.get_json(
+                        "/eth/v1/beacon/light_client/finality_update"
                     )
-            except Exception as e:  # not yet available — keep polling
-                if "404" not in str(e):
-                    raise
-            await asyncio.sleep(1.0)
+                    fu = from_json(ssz.altair.LightClientFinalityUpdate, fu_json)
+                    key = (fu.signature_slot, fu.attested_header.slot)
+                    if key not in seen_sigs:
+                        seen_sigs.add(key)
+                        lc.process_finality_update(fu)
+                        processed += 1
+                        print(
+                            json.dumps(
+                                {
+                                    "finalized_slot": lc.store.finalized_header.slot,
+                                    "optimistic_slot": lc.store.optimistic_header.slot,
+                                }
+                            ),
+                            flush=True,
+                        )
+                except Exception as e:  # not yet available — keep polling
+                    if "404" not in str(e):
+                        raise
+                await asyncio.sleep(1.0)
+        finally:
+            await api.close()
 
     asyncio.run(run())
     return 0
@@ -667,24 +683,26 @@ def run_validator_exit(args) -> int:
 
     async def run():
         api = ApiClient(args.beacon_url)
-        genesis = await api.get_genesis()
-        gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
-        sk = interop_secret_keys(args.index + 1)[args.index]
-        store = ValidatorStore([sk], ForkConfig(cfg), gvr)
-        if args.epoch is not None:
-            epoch = args.epoch
-        else:
-            from lodestar_tpu.params import SLOTS_PER_EPOCH
+        try:
+            genesis = await api.get_genesis()
+            gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+            sk = interop_secret_keys(args.index + 1)[args.index]
+            store = ValidatorStore([sk], ForkConfig(cfg), gvr)
+            if args.epoch is not None:
+                epoch = args.epoch
+            else:
+                from lodestar_tpu.params import SLOTS_PER_EPOCH
 
-            genesis_time = int(genesis["genesis_time"])
-            epoch = max(
-                0, int((time.time() - genesis_time) / cfg.SECONDS_PER_SLOT)
-            ) // SLOTS_PER_EPOCH
-        signed = store.sign_voluntary_exit(
-            sk.to_public_key().to_bytes(), args.index, epoch
-        )
-        await api.submit_voluntary_exit(signed)
-        await api.close()
+                genesis_time = int(genesis["genesis_time"])
+                epoch = max(
+                    0, int((time.time() - genesis_time) / cfg.SECONDS_PER_SLOT)
+                ) // SLOTS_PER_EPOCH
+            signed = store.sign_voluntary_exit(
+                sk.to_public_key().to_bytes(), args.index, epoch
+            )
+            await api.submit_voluntary_exit(signed)
+        finally:
+            await api.close()
         print(json.dumps({"submitted_exit": args.index, "epoch": epoch}))
 
     asyncio.run(run())
@@ -727,20 +745,24 @@ def run_flare(args) -> int:
 
     async def run():
         api = ApiClient(args.beacon_url)
-        genesis = await api.get_genesis()
-        gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
-        sk = interop_secret_keys(args.index + 1)[args.index]
-        if args.action == "self-slash-attester":
-            s = make_self_attester_slashing(cfg, gvr, sk, args.index, args.epoch)
-            await api.submit_attester_slashing(s)
-        else:
-            from lodestar_tpu.params import SLOTS_PER_EPOCH
+        try:
+            genesis = await api.get_genesis()
+            gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+            sk = interop_secret_keys(args.index + 1)[args.index]
+            if args.action == "self-slash-attester":
+                s = make_self_attester_slashing(
+                    cfg, gvr, sk, args.index, args.epoch
+                )
+                await api.submit_attester_slashing(s)
+            else:
+                from lodestar_tpu.params import SLOTS_PER_EPOCH
 
-            s = make_self_proposer_slashing(
-                cfg, gvr, sk, args.index, args.epoch * SLOTS_PER_EPOCH + 1
-            )
-            await api.submit_proposer_slashing(s)
-        await api.close()
+                s = make_self_proposer_slashing(
+                    cfg, gvr, sk, args.index, args.epoch * SLOTS_PER_EPOCH + 1
+                )
+                await api.submit_proposer_slashing(s)
+        finally:
+            await api.close()
         print(json.dumps({"submitted": args.action, "index": args.index}))
 
     asyncio.run(run())

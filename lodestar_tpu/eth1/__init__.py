@@ -148,7 +148,9 @@ class Eth1DepositDataTracker:
             blk = await self.provider.get_block(n)
             if blk is not None:
                 self.block_cache.append(blk)
-        self._synced_to = head
+        # single-owner: the eth1 follow task is the only writer of
+        # _synced_to; the read->await->write spans only its own loop
+        self._synced_to = head  # lodelint: disable=await-in-critical
         return len(events)
 
     # -- eth1 data voting (spec get_eth1_vote) --------------------------

@@ -12,6 +12,7 @@ from __future__ import annotations
 import secrets
 from typing import Dict, Optional
 
+from lodestar_tpu.execution.http_session import ReusedClientSession
 from lodestar_tpu.params import ForkName
 from lodestar_tpu.types import ssz
 
@@ -20,7 +21,7 @@ class BuilderApiError(Exception):
     pass
 
 
-class HttpBuilderApi:
+class HttpBuilderApi(ReusedClientSession):
     """builder-specs REST client (http.ts role)."""
 
     def __init__(self, base_url: str, timeout: float = 12.0):
@@ -30,18 +31,18 @@ class HttpBuilderApi:
     async def _req(self, method: str, path: str, body: Optional[bytes] = None):
         import aiohttp
 
-        async with aiohttp.ClientSession() as session:
-            async with session.request(
-                method,
-                self.base_url + path,
-                data=body,
-                headers={"Content-Type": "application/octet-stream"},
-                timeout=aiohttp.ClientTimeout(total=self.timeout),
-            ) as resp:
-                data = await resp.read()
-                if resp.status >= 400:
-                    raise BuilderApiError(f"{path}: HTTP {resp.status}")
-                return data
+        session = await self._ses()
+        async with session.request(
+            method,
+            self.base_url + path,
+            data=body,
+            headers={"Content-Type": "application/octet-stream"},
+            timeout=aiohttp.ClientTimeout(total=self.timeout),
+        ) as resp:
+            data = await resp.read()
+            if resp.status >= 400:
+                raise BuilderApiError(f"{path}: HTTP {resp.status}")
+            return data
 
     async def check_status(self) -> None:
         await self._req("GET", "/eth/v1/builder/status")

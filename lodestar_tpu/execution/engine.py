@@ -14,6 +14,8 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Dict, Optional, Protocol
 
+from lodestar_tpu.execution.http_session import ReusedClientSession
+
 
 class ExecutePayloadStatus(str, Enum):
     VALID = "VALID"
@@ -167,7 +169,7 @@ class MockExecutionEngine:
         )
 
 
-class HttpExecutionEngine:
+class HttpExecutionEngine(ReusedClientSession):
     """engine_* JSON-RPC client (http.ts).  Supports the jwt-secret auth
     the Engine API requires."""
 
@@ -184,14 +186,14 @@ class HttpExecutionEngine:
         headers = {}
         if self.jwt_secret is not None:
             headers["Authorization"] = f"Bearer {self._jwt_token()}"
-        async with aiohttp.ClientSession() as session:
-            async with session.post(
-                self.url,
-                json={"jsonrpc": "2.0", "id": self._id, "method": method, "params": params},
-                headers=headers,
-                timeout=aiohttp.ClientTimeout(total=self.timeout),
-            ) as resp:
-                body = await resp.json()
+        session = await self._ses()
+        async with session.post(
+            self.url,
+            json={"jsonrpc": "2.0", "id": self._id, "method": method, "params": params},
+            headers=headers,
+            timeout=aiohttp.ClientTimeout(total=self.timeout),
+        ) as resp:
+            body = await resp.json()
         if "error" in body:
             raise RuntimeError(f"{method}: {body['error']}")
         return body["result"]

@@ -1,0 +1,44 @@
+"""Shared aiohttp session reuse for the long-lived HTTP clients.
+
+HttpExecutionEngine, HttpBuilderApi, and the beacon ApiClient each talk
+to a single upstream over many small requests; creating a ClientSession
+per request costs a connector + FD churn on every call (painful on the
+2-core host).  This mixin keeps one lazily-created session per
+instance, re-creates it if something closed it out from under us while
+the client is live, and refuses to resurrect it after an explicit
+``close()`` — a late request from a draining task must fail loudly, not
+leak a fresh connector.
+
+Ownership: whoever wires the client owns its shutdown.  Engine/builder
+instances are injected into BeaconChain / BeaconRestApiServer and those
+hosts close them; the validator/lightclient CLI constructs its own
+ApiClient and closes it in a ``finally``.  A client instance must not
+be shared across owners or reused after its owner shuts down; build a
+fresh client instead.
+"""
+from __future__ import annotations
+
+
+class ReusedClientSession:
+    """Per-instance aiohttp.ClientSession, created on first use and
+    reused across requests; ``close()`` releases it (idempotent) and
+    makes any later ``_ses()`` raise."""
+
+    _session = None  # set lazily; class defaults keep __init__ optional
+    _ses_closed = False
+
+    async def _ses(self):
+        import aiohttp
+
+        if self._ses_closed:
+            raise RuntimeError(
+                f"{type(self).__name__} is closed; no further HTTP requests"
+            )
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        self._ses_closed = True
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

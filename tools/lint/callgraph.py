@@ -1,0 +1,745 @@
+"""Repo-wide call graph for lodelint's interprocedural rules.
+
+Two layers:
+
+* **Extraction** (`extract_summary`) — one pass over a module's AST
+  producing a JSON-serializable ``ModuleSummary``: every function
+  (module-level, methods, nested defs) with its raw call references and
+  direct effect set, the import table (aliases and relative imports
+  resolved to absolute dotted paths), per-class instance-attribute type
+  candidates, and protocol/base-class shape.  Summaries are what the
+  mtime-keyed cache stores (see effects.SummaryCache), so an unchanged
+  file contributes to the graph without being re-parsed.
+
+* **Resolution** (`Project`) — links summaries into a graph of
+  ``module:qualname -> [Edge]``.  Resolution is deliberately static and
+  conservative:
+
+    - bare names walk the lexical scope chain (nested defs first), then
+      module functions/classes, then the import table;
+    - ``self.method()`` dispatches through the enclosing class's MRO
+      (base classes resolved across modules);
+    - attribute chains (``self.db.block.put``) walk inferred instance
+      attribute types class by class;
+    - a call on a Protocol-typed value fans out to every concrete
+      project class that implements the protocol's full method set —
+      this is how ``Repository.put`` reaches both MemoryController and
+      SqliteController.
+
+  Anything unresolvable simply contributes no edge: the analysis
+  under-approximates reachability, so interprocedural findings are
+  backed by a concrete, reportable chain rather than guesswork.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import annotate_parents, dotted_name, parse_suppressions
+from .effects import direct_effects, module_effect_context
+
+# call wrappers that schedule/await the coroutine they are handed — a
+# known-async call inside one of these is NOT an unawaited coroutine
+_CORO_WRAPPERS = {
+    "create_task",
+    "ensure_future",
+    "gather",
+    "wait",
+    "wait_for",
+    "shield",
+    "run",
+    "run_until_complete",
+    "run_coroutine_threadsafe",
+    "as_completed",
+    "timeout",
+    "Task",
+}
+
+
+def module_name_for(path: str) -> str:
+    """Repo-relative path -> dotted module ('a/b/__init__.py' -> 'a.b')."""
+    parts = path[:-3].split("/") if path.endswith(".py") else path.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p)
+
+
+def _ann_refs(node: Optional[ast.AST]) -> List[str]:
+    """Type-reference candidates named by an annotation.  Unwraps
+    Optional[X] / X | None; anything fancier contributes nothing."""
+    if node is None:
+        return []
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        dn = dotted_name(node)
+        return [dn] if dn else []
+    if isinstance(node, ast.Subscript):
+        base = dotted_name(node.value) or ""
+        if base.rsplit(".", 1)[-1] in ("Optional", "Union", "Type", "type"):
+            inner = node.slice
+            elts = inner.elts if isinstance(inner, ast.Tuple) else [inner]
+            out: List[str] = []
+            for e in elts:
+                out.extend(_ann_refs(e))
+            return out
+        return []
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return _ann_refs(node.left) + _ann_refs(node.right)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]  # string annotation: 'KvController'
+    return []
+
+
+def _expr_type_refs(
+    node: ast.AST, params: Dict[str, List[str]], local_types: Dict[str, List[str]]
+) -> List[str]:
+    """Candidate type references for an assigned expression: constructor
+    calls, annotated params, previously-typed locals; IfExp/BoolOp union
+    their branches (``controller if controller else MemoryController()``)."""
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func)
+        return [dn] if dn else []
+    if isinstance(node, ast.Name):
+        return list(params.get(node.id, [])) + list(local_types.get(node.id, []))
+    if isinstance(node, ast.IfExp):
+        return _expr_type_refs(node.body, params, local_types) + _expr_type_refs(
+            node.orelse, params, local_types
+        )
+    if isinstance(node, ast.BoolOp):
+        out: List[str] = []
+        for v in node.values:
+            out.extend(_expr_type_refs(v, params, local_types))
+        return out
+    if isinstance(node, ast.Await):
+        return _expr_type_refs(node.value, params, local_types)
+    return []
+
+
+def walk_own(func: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's body excluding nested def/lambda subtrees (their
+    effects/calls belong to the nested function, which gets its own graph
+    node) and excluding the decorator list (runs in the enclosing scope)."""
+    stack: List[ast.AST] = list(func.body)  # type: ignore[attr-defined]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _interface_marker(func: ast.AST) -> bool:
+    """True when a stub body is spelled `...` or raise NotImplementedError
+    — the idioms that mark an interface, unlike a plain `pass` stub."""
+    for s in getattr(func, "body", []):
+        if (
+            isinstance(s, ast.Expr)
+            and isinstance(s.value, ast.Constant)
+            and s.value.value is Ellipsis
+        ):
+            return True
+        if isinstance(s, ast.Raise) and s.exc is not None:
+            exc = s.exc.func if isinstance(s.exc, ast.Call) else s.exc
+            if (dotted_name(exc) or "").endswith("NotImplementedError"):
+                return True
+    return False
+
+
+def _empty_body(func: ast.AST) -> bool:
+    body = list(getattr(func, "body", []))
+    if body and isinstance(body[0], ast.Expr) and isinstance(
+        body[0].value, ast.Constant
+    ) and isinstance(body[0].value.value, str):
+        body = body[1:]  # docstring
+    return all(
+        isinstance(s, ast.Pass)
+        or (
+            isinstance(s, ast.Expr)
+            and isinstance(s.value, ast.Constant)
+            and s.value.value is Ellipsis
+        )
+        or (isinstance(s, ast.Raise) and s.cause is None and s.exc is not None
+            and (dotted_name(s.exc if not isinstance(s.exc, ast.Call) else s.exc.func)
+                 or "").endswith("NotImplementedError"))
+        for s in body
+    )
+
+
+class _Extractor(ast.NodeVisitor):
+    def __init__(self, module: str, path: str):
+        self.module = module
+        self.path = path
+        self.imports: Dict[str, str] = {}
+        self.classes: Dict[str, dict] = {}
+        self.functions: List[dict] = []
+        self.module_vars: Dict[str, List[str]] = {}
+        self.scope: List[Tuple[str, str]] = []  # (kind, name)
+        self.ctx = None  # module_effect_context, set in extract_summary
+
+    # -- imports ------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.imports[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+            if a.asname is None and "." in a.name:
+                # `import a.b.c` binds `a`, but the full path is usable
+                # through the bound root; record the root mapping only
+                self.imports.setdefault(a.name.split(".")[0], a.name.split(".")[0])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level:
+            parts = self.module.split(".")
+            # a package __init__'s `from . import x` is relative to the
+            # package itself; a plain module's is relative to its parent
+            is_pkg = self.path.endswith("/__init__.py")
+            up = node.level - (1 if is_pkg else 0)
+            base = parts[: len(parts) - up] if up else parts
+            prefix = ".".join(base + ([node.module] if node.module else []))
+        else:
+            prefix = node.module or ""
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = (
+                f"{prefix}.{a.name}" if prefix else a.name
+            )
+        self.generic_visit(node)
+
+    # -- classes ------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        qname = ".".join([n for _, n in self.scope] + [node.name])
+        bases = [dotted_name(b) for b in node.bases]
+        bases = [b for b in bases if b]
+        methods = {
+            s.name
+            for s in node.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        method_nodes = [
+            s
+            for s in node.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # protocol-like: declares the Protocol base, or is an interface
+        # sketch (all methods empty, at least one spelled with `...` or
+        # NotImplementedError — a pass-only class is just a stub impl)
+        is_protocol = any(b.rsplit(".", 1)[-1] == "Protocol" for b in bases) or (
+            bool(method_nodes)
+            and all(_empty_body(s) for s in method_nodes)
+            and any(_interface_marker(s) for s in method_nodes)
+        )
+        self.classes[qname] = {
+            "bases": bases,
+            "methods": sorted(methods),
+            "protocol": is_protocol,
+            "attr_types": {},
+        }
+        self.scope.append(("class", node.name))
+        self.generic_visit(node)
+        self.scope.pop()
+
+    # -- functions ----------------------------------------------------
+
+    def _enclosing_class(self) -> Optional[str]:
+        for i in range(len(self.scope) - 1, -1, -1):
+            if self.scope[i][0] == "class":
+                return ".".join(n for _, n in self.scope[: i + 1])
+        return None
+
+    def _visit_func(self, node, is_async: bool) -> None:
+        qname = ".".join([n for _, n in self.scope] + [node.name])
+        cls = self._enclosing_class()
+        params: Dict[str, List[str]] = {}
+        all_args = list(node.args.posonlyargs) + list(node.args.args) + list(
+            node.args.kwonlyargs
+        )
+        for arg in all_args:
+            refs = _ann_refs(arg.annotation)
+            if refs:
+                params[arg.arg] = refs
+
+        local_types: Dict[str, List[str]] = {}
+        globals_decl: Set[str] = set()
+        own = list(walk_own(node))
+        # two passes: types first (assignment order approximation), then
+        # calls/effects so `v = Foo(); v.m()` resolves within one body
+        for n in sorted(
+            (x for x in own if isinstance(x, (ast.Assign, ast.AnnAssign, ast.Global))),
+            key=lambda x: (getattr(x, "lineno", 0), getattr(x, "col_offset", 0)),
+        ):
+            if isinstance(n, ast.Global):
+                globals_decl.update(n.names)
+                continue
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            value = n.value
+            refs = (
+                _ann_refs(n.annotation)
+                if isinstance(n, ast.AnnAssign) and n.annotation is not None
+                else []
+            )
+            if value is not None and not refs:
+                refs = _expr_type_refs(value, params, local_types)
+            for t in targets:
+                if isinstance(t, ast.Name) and refs:
+                    local_types.setdefault(t.id, []).extend(
+                        r for r in refs if r not in local_types.get(t.id, [])
+                    )
+                elif (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and cls is not None
+                    and refs
+                ):
+                    at = self.classes.get(cls, {}).get("attr_types")
+                    if at is not None:
+                        cur = at.setdefault(t.attr, [])
+                        cur.extend(r for r in refs if r not in cur)
+
+        calls = self._collect_calls(own)
+        effects = direct_effects(own, self.ctx, cls=cls, globals_decl=globals_decl)
+        self.functions.append(
+            {
+                "qname": qname,
+                "line": node.lineno,
+                "col": node.col_offset,
+                "is_async": is_async,
+                "cls": cls,
+                "params": params,
+                "locals": local_types,
+                "calls": calls,
+                "effects": effects,
+            }
+        )
+        self.scope.append(("func", node.name))
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node, is_async=True)
+
+    # -- module-level vars --------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self.scope:
+            refs = _expr_type_refs(node.value, {}, {})
+            for t in node.targets:
+                if isinstance(t, ast.Name) and refs:
+                    self.module_vars[t.id] = refs
+        self.generic_visit(node)
+
+    # -- call collection ----------------------------------------------
+
+    def _collect_calls(self, own: Sequence[ast.AST]) -> List[dict]:
+        out: List[dict] = []
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            target = dotted_name(node.func)
+            if not target:
+                continue
+            awaited = wrapped = False
+            cur: ast.AST = node
+            parent = getattr(cur, "_ll_parent", None)
+            while parent is not None and not isinstance(parent, ast.stmt):
+                if isinstance(parent, ast.Await):
+                    awaited = True
+                    break
+                if isinstance(parent, ast.Call) and parent is not cur:
+                    fn = dotted_name(parent.func) or ""
+                    if fn.rsplit(".", 1)[-1] in _CORO_WRAPPERS:
+                        wrapped = True
+                        break
+                cur = parent
+                parent = getattr(cur, "_ll_parent", None)
+            discarded = isinstance(
+                getattr(node, "_ll_parent", None), ast.Expr
+            )
+            out.append(
+                {
+                    "target": target,
+                    "line": node.lineno,
+                    "col": node.col_offset,
+                    "awaited": awaited,
+                    "wrapped": wrapped,
+                    "discarded": discarded,
+                }
+            )
+        return out
+
+
+def extract_summary(
+    tree: ast.Module, text: str, path: str, suppressions=None
+) -> dict:
+    """Build the JSON-serializable ModuleSummary for one parsed file.
+    ``annotate_parents`` must already have run on ``tree``."""
+    module = module_name_for(path)
+    ex = _Extractor(module, path)
+    ex.ctx = module_effect_context(tree)
+    ex.visit(tree)
+    per_line, per_file = (
+        suppressions if suppressions is not None else parse_suppressions(text)
+    )
+    return {
+        "module": module,
+        "path": path,
+        "imports": ex.imports,
+        "classes": ex.classes,
+        "module_vars": ex.module_vars,
+        "functions": ex.functions,
+        "suppress_lines": {str(k): sorted(v) for k, v in per_line.items()},
+        "suppress_file": sorted(per_file),
+    }
+
+
+def summary_for_source(text: str, path: str) -> Optional[dict]:
+    """Parse + extract in one step (tests, check_source); None on a
+    syntax error (the parse-error finding is per-file territory)."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return None
+    annotate_parents(tree)
+    return extract_summary(tree, text, path)
+
+
+# ---------------------------------------------------------------------------
+# project resolution
+# ---------------------------------------------------------------------------
+
+
+class Edge:
+    __slots__ = ("callee", "line", "col", "awaited", "wrapped", "discarded")
+
+    def __init__(self, callee, line, col, awaited, wrapped, discarded):
+        self.callee = callee
+        self.line = line
+        self.col = col
+        self.awaited = awaited
+        self.wrapped = wrapped
+        self.discarded = discarded
+
+
+class Func:
+    __slots__ = (
+        "fq", "module", "qname", "path", "line", "col",
+        "is_async", "cls", "effects", "edges",
+    )
+
+    def __init__(self, module: str, path: str, fs: dict):
+        self.module = module
+        self.path = path
+        self.qname = fs["qname"]
+        self.fq = f"{module}:{self.qname}"
+        self.line = fs["line"]
+        self.col = fs["col"]
+        self.is_async = fs["is_async"]
+        self.cls = fs["cls"]
+        self.effects = fs["effects"]
+        self.edges: List[Edge] = []
+
+
+class Project:
+    """Linked call graph over a set of ModuleSummaries."""
+
+    def __init__(self, summaries: Sequence[dict]):
+        self.summaries: Dict[str, dict] = {s["module"]: s for s in summaries}
+        self.funcs: Dict[str, Func] = {}
+        self._impl_cache: Dict[str, List[Tuple[str, str]]] = {}
+        for s in summaries:
+            for fs in s["functions"]:
+                fn = Func(s["module"], s["path"], fs)
+                self.funcs[fn.fq] = fn
+        self._resolve_all()
+        # filled by effects.propagate()
+        self.inherited: Dict[str, Dict[str, Edge]] = {}
+
+    # -- suppressions -------------------------------------------------
+
+    def suppressed(self, path: str, line: int, rule: str) -> bool:
+        for s in self.summaries.values():
+            if s["path"] != path:
+                continue
+            if rule in s.get("suppress_file", []):
+                return True
+            return rule in s.get("suppress_lines", {}).get(str(line), [])
+        return False
+
+    # -- type/class helpers -------------------------------------------
+
+    def _find_class(self, module: str, name: str) -> Optional[Tuple[str, str]]:
+        s = self.summaries.get(module)
+        if s and name in s["classes"]:
+            return (module, name)
+        return None
+
+    def resolve_type_ref(self, module: str, ref: str) -> Optional[Tuple[str, str]]:
+        """'KvController' / 'controller.KvController' (as written in
+        ``module``) -> (defining_module, class_qname)."""
+        s = self.summaries.get(module)
+        if s is None:
+            return None
+        hit = self._find_class(module, ref)
+        if hit:
+            return hit
+        head, _, rest = ref.partition(".")
+        target = s["imports"].get(head)
+        if target:
+            full = target + ("." + rest if rest else "")
+        else:
+            full = ref
+        # longest module prefix wins: a.b.C / a.b.Outer.Inner
+        parts = full.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod in self.summaries:
+                cls = ".".join(parts[i:])
+                return self._find_class(mod, cls)
+        return None
+
+    def _mro_method(
+        self, module: str, cls: str, method: str, _seen: Optional[Set] = None
+    ) -> Optional[str]:
+        seen = _seen or set()
+        if (module, cls) in seen:
+            return None
+        seen.add((module, cls))
+        s = self.summaries.get(module)
+        info = s["classes"].get(cls) if s else None
+        if info is None:
+            return None
+        if method in info["methods"]:
+            return f"{module}:{cls}.{method}"
+        for base in info["bases"]:
+            loc = self.resolve_type_ref(module, base)
+            if loc:
+                hit = self._mro_method(loc[0], loc[1], method, seen)
+                if hit:
+                    return hit
+        return None
+
+    def _protocol_impls(self, module: str, cls: str) -> List[Tuple[str, str]]:
+        key = f"{module}:{cls}"
+        if key in self._impl_cache:
+            return self._impl_cache[key]
+        info = self.summaries[module]["classes"][cls]
+        need = set(info["methods"])
+        impls: List[Tuple[str, str]] = []
+        if need:
+            for m, s in self.summaries.items():
+                for cname, cinfo in s["classes"].items():
+                    if cinfo["protocol"] or (m, cname) == (module, cls):
+                        continue
+                    have = set(cinfo["methods"])
+                    for base in cinfo["bases"]:
+                        loc = self.resolve_type_ref(m, base)
+                        if loc:
+                            have |= set(
+                                self.summaries[loc[0]]["classes"][loc[1]]["methods"]
+                            )
+                    if need <= have:
+                        impls.append((m, cname))
+        self._impl_cache[key] = impls
+        return impls
+
+    def _method_targets(
+        self, module: str, cls: str, method: str
+    ) -> List[str]:
+        info = self.summaries.get(module, {}).get("classes", {}).get(cls)
+        if info is None:
+            return []
+        if info["protocol"]:
+            out = []
+            for m, c in self._protocol_impls(module, cls):
+                hit = self._mro_method(m, c, method)
+                if hit:
+                    out.append(hit)
+            return out
+        hit = self._mro_method(module, cls, method)
+        return [hit] if hit else []
+
+    def _attr_type(
+        self, module: str, cls: str, attr: str
+    ) -> List[Tuple[str, str]]:
+        info = self.summaries.get(module, {}).get("classes", {}).get(cls)
+        if info is None:
+            return []
+        out: List[Tuple[str, str]] = []
+        for ref in info["attr_types"].get(attr, []):
+            loc = self.resolve_type_ref(module, ref)
+            if loc and loc not in out:
+                out.append(loc)
+        return out
+
+    # -- call resolution ----------------------------------------------
+
+    def _resolve_name(self, s: dict, fs: dict, name: str) -> List[str]:
+        module = s["module"]
+        # lexical scope chain: f.g.name for each ancestor scope of qname
+        scope_parts = fs["qname"].split(".")[:-1]
+        for i in range(len(scope_parts), -1, -1):
+            cand = ".".join(scope_parts[:i] + [name])
+            fq = f"{module}:{cand}"
+            if fq in self.funcs:
+                # method names aren't visible as bare names inside a
+                # method body — skip candidates whose parent is a class
+                parent = ".".join(cand.split(".")[:-1])
+                if parent and parent in s["classes"]:
+                    continue
+                return [fq]
+        if name in s["classes"]:
+            return self._method_targets(module, name, "__init__")
+        target = s["imports"].get(name)
+        if target:
+            return self._resolve_dotted_abs(target)
+        return []
+
+    def _resolve_dotted_abs(self, full: str) -> List[str]:
+        """Absolute dotted path -> function/class-ctor targets."""
+        parts = full.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            s = self.summaries.get(mod)
+            if s is None:
+                continue
+            rest = parts[i:]
+            if len(rest) == 1:
+                fq = f"{mod}:{rest[0]}"
+                if fq in self.funcs:
+                    return [fq]
+                if rest[0] in s["classes"]:
+                    return self._method_targets(mod, rest[0], "__init__")
+            else:
+                cls = ".".join(rest[:-1])
+                if cls in s["classes"]:
+                    return self._method_targets(mod, cls, rest[-1])
+            return []
+        return []
+
+    def _walk_attr_chain(
+        self, start: List[Tuple[str, str]], mids: Sequence[str], method: str
+    ) -> List[str]:
+        cur = start
+        for attr in mids:
+            nxt: List[Tuple[str, str]] = []
+            for mod, cls in cur:
+                for loc in self._attr_type(mod, cls, attr):
+                    if loc not in nxt:
+                        nxt.append(loc)
+                # a protocol's attr types aren't declared; widen through
+                # implementations so self.db.<proto attr> still chains
+                info = self.summaries.get(mod, {}).get("classes", {}).get(cls)
+                if info and info["protocol"]:
+                    for m2, c2 in self._protocol_impls(mod, cls):
+                        for loc in self._attr_type(m2, c2, attr):
+                            if loc not in nxt:
+                                nxt.append(loc)
+            cur = nxt
+            if not cur:
+                return []
+        out: List[str] = []
+        for mod, cls in cur:
+            for fq in self._method_targets(mod, cls, method):
+                if fq not in out:
+                    out.append(fq)
+        return out
+
+    def _resolve_call(self, s: dict, fs: dict, target: str) -> List[str]:
+        module = s["module"]
+        if "." not in target:
+            return self._resolve_name(s, fs, target)
+        parts = target.split(".")
+        head, mids, method = parts[0], parts[1:-1], parts[-1]
+        if head == "self" and fs["cls"]:
+            if not mids:
+                return self._method_targets(module, fs["cls"], method)
+            start = [(module, fs["cls"])]
+            return self._walk_attr_chain(start, mids, method)
+        # typed local / param / module var roots
+        root_refs = (
+            fs.get("locals", {}).get(head, [])
+            + fs.get("params", {}).get(head, [])
+            + s.get("module_vars", {}).get(head, [])
+        )
+        start = []
+        for ref in root_refs:
+            loc = self.resolve_type_ref(module, ref)
+            if loc and loc not in start:
+                start.append(loc)
+        if start:
+            return self._walk_attr_chain(start, mids, method)
+        # import roots: mod.func / pkg.mod.Class.method / alias.func
+        imp = s["imports"].get(head)
+        full = (imp + "." + ".".join(parts[1:])) if imp else target
+        return self._resolve_dotted_abs(full)
+
+    def _resolve_all(self) -> None:
+        for s in self.summaries.values():
+            for fs in s["functions"]:
+                fn = self.funcs[f"{s['module']}:{fs['qname']}"]
+                for c in fs["calls"]:
+                    for callee in self._resolve_call(s, fs, c["target"]):
+                        if callee == fn.fq:
+                            continue  # direct self-recursion adds nothing
+                        fn.edges.append(
+                            Edge(
+                                callee,
+                                c["line"],
+                                c["col"],
+                                c["awaited"],
+                                c["wrapped"],
+                                c["discarded"],
+                            )
+                        )
+
+    # -- reporting -----------------------------------------------------
+
+    def graph_lines(self) -> List[str]:
+        """Human-readable adjacency dump for ``--graph``."""
+        out: List[str] = []
+        for fq in sorted(self.funcs):
+            fn = self.funcs[fq]
+            effs = sorted(
+                set(fn.effects) | set(self.inherited.get(fq, {}))
+            )
+            tag = " async" if fn.is_async else ""
+            eff = f" [{','.join(effs)}]" if effs else ""
+            out.append(f"{fq}{tag}{eff}  ({fn.path}:{fn.line})")
+            seen: Set[str] = set()
+            for e in fn.edges:
+                if e.callee in seen:
+                    continue
+                seen.add(e.callee)
+                out.append(f"    -> {e.callee}  (line {e.line})")
+        return out
+
+    def graph_json(self) -> List[dict]:
+        out = []
+        for fq in sorted(self.funcs):
+            fn = self.funcs[fq]
+            out.append(
+                {
+                    "function": fq,
+                    "path": fn.path,
+                    "line": fn.line,
+                    "async": fn.is_async,
+                    "effects": sorted(fn.effects),
+                    "inherited_effects": sorted(self.inherited.get(fq, {})),
+                    "calls": sorted({e.callee for e in fn.edges}),
+                }
+            )
+        return out
+
+
+def build_project(summaries: Sequence[dict]) -> Project:
+    from . import effects as _eff
+
+    project = Project([s for s in summaries if s is not None])
+    _eff.propagate(project)
+    return project

@@ -45,9 +45,17 @@ class Finding:
     col: int
     rule: str
     message: str
+    # interprocedural findings carry the effect set that fired and the
+    # call chain proving reachability (frames 'path:line qualname');
+    # per-file findings leave both empty
+    effects: Tuple[str, ...] = ()
+    chain: Tuple[str, ...] = ()
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col + 1} [{self.rule}] {self.message}"
+        out = f"{self.path}:{self.line}:{self.col + 1} [{self.rule}] {self.message}"
+        for frame in self.chain:
+            out += f"\n    via {frame}"
+        return out
 
     def as_json(self) -> dict:
         return {
@@ -56,7 +64,32 @@ class Finding:
             "col": self.col + 1,
             "rule": self.rule,
             "message": self.message,
+            "effects": list(self.effects),
+            "chain": list(self.chain),
         }
+
+    def to_cache(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+            "effects": list(self.effects),
+            "chain": list(self.chain),
+        }
+
+    @classmethod
+    def from_cache(cls, d: dict) -> "Finding":
+        return cls(
+            path=d["path"],
+            line=d["line"],
+            col=d["col"],
+            rule=d["rule"],
+            message=d["message"],
+            effects=tuple(d.get("effects", ())),
+            chain=tuple(d.get("chain", ())),
+        )
 
 
 class Rule:
@@ -82,6 +115,20 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """Whole-program invariant: sees the linked call graph + effect
+    lattice (a ``callgraph.Project``) instead of a single file's AST.
+    Suppression is honored at the finding's anchor line AND at the
+    chain's root effect site — mark the root cause once, every caller
+    stays quiet."""
+
+    def check(self, tree: ast.Module, text: str, path: str) -> List["Finding"]:
+        return []
+
+    def check_project(self, project) -> List["Finding"]:
+        raise NotImplementedError
+
+
 RULES: Dict[str, Rule] = {}
 
 
@@ -97,13 +144,25 @@ def register(cls):
 # ---------------------------------------------------------------------------
 
 def annotate_parents(tree: ast.AST) -> None:
+    nodes = []
     for node in ast.walk(tree):
+        nodes.append(node)
         for field, value in ast.iter_fields(node):
             children = value if isinstance(value, list) else [value]
             for child in children:
                 if isinstance(child, ast.AST):
                     child._ll_parent = node  # type: ignore[attr-defined]
                     child._ll_field = field  # type: ignore[attr-defined]
+    # one shared traversal: a dozen rules iterating every node each would
+    # dominate whole-repo lint time (see walk_tree)
+    tree._ll_nodes = nodes  # type: ignore[attr-defined]
+
+
+def walk_tree(tree: ast.AST):
+    """ast.walk(tree), but reusing the node list annotate_parents already
+    built when available.  Rules should use this for whole-tree scans."""
+    nodes = getattr(tree, "_ll_nodes", None)
+    return nodes if nodes is not None else ast.walk(tree)
 
 
 def parent_chain(node: ast.AST) -> Iterable[Tuple[ast.AST, ast.AST, str]]:
@@ -176,6 +235,8 @@ def parse_suppressions(text: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
     anything for the real file containing it."""
     per_line: Dict[int, Set[str]] = {}
     per_file: Set[str] = set()
+    if "lodelint" not in text:
+        return per_line, per_file  # no directive anywhere: skip tokenizing
     try:
         comments = [
             (tok.start[0], tok.string)
@@ -200,18 +261,23 @@ def parse_suppressions(text: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
 # runner
 # ---------------------------------------------------------------------------
 
-def check_source(
+def analyze_source(
     text: str, path: str, rule_ids: Optional[Sequence[str]] = None
-) -> List[Finding]:
-    """Lint one source string.  ``path`` is repo-relative and drives
-    per-rule ``applies`` scoping (tests pass synthetic paths)."""
+) -> Tuple[List[Finding], Optional[dict]]:
+    """One parse of one file: (per-file findings, module summary for the
+    call graph — None when the source doesn't parse)."""
+    from . import callgraph
+
     try:
         tree = ast.parse(text)
     except SyntaxError as e:
-        return [
-            Finding(path=path, line=e.lineno or 1, col=0, rule="parse-error",
-                    message=f"could not parse: {e.msg}")
-        ]
+        return (
+            [
+                Finding(path=path, line=e.lineno or 1, col=0, rule="parse-error",
+                        message=f"could not parse: {e.msg}")
+            ],
+            None,
+        )
     annotate_parents(tree)
     per_line, per_file = parse_suppressions(text)
     rules = (
@@ -219,12 +285,39 @@ def check_source(
     )
     findings: List[Finding] = []
     for rule in rules:
-        if not rule.applies(path):
+        if isinstance(rule, ProjectRule) or not rule.applies(path):
             continue
         for f in rule.check(tree, text, path):
             if f.rule in per_file or f.rule in per_line.get(f.line, set()):
                 continue
             findings.append(f)
+    summary = callgraph.extract_summary(
+        tree, text, path, suppressions=(per_line, per_file)
+    )
+    return findings, summary
+
+
+def check_source(
+    text: str,
+    path: str,
+    rule_ids: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint one source string.  ``path`` is repo-relative and drives
+    per-rule ``applies`` scoping (tests pass synthetic paths).  Project
+    rules run over a single-file call graph, so interprocedural fixtures
+    work on one source string; ``run`` builds the whole-repo graph once
+    instead."""
+    from . import callgraph
+
+    findings, summary = analyze_source(text, path, rule_ids)
+    rules = (
+        [RULES[r] for r in rule_ids] if rule_ids is not None else list(RULES.values())
+    )
+    if summary is not None and any(isinstance(r, ProjectRule) for r in rules):
+        project = callgraph.build_project([summary])
+        for rule in rules:
+            if isinstance(rule, ProjectRule):
+                findings.extend(rule.check_project(project))
     return sorted(findings)
 
 
@@ -286,19 +379,64 @@ def write_baseline(
         fh.write("\n")
 
 
+def collect(
+    paths: Sequence[str], use_cache: bool = True
+) -> Tuple[List[Finding], List[dict]]:
+    """Per-file pass over ``paths``: (per-file findings, module
+    summaries for the call graph).  Unchanged files come straight from
+    the (mtime, size)-keyed summary cache — no parse, no rule run."""
+    from .effects import SummaryCache
+
+    cache = SummaryCache() if use_cache else None
+    findings: List[Finding] = []
+    summaries: List[dict] = []
+    for fp in iter_py_files(paths):
+        rel = _rel(fp)
+        st = os.stat(fp)
+        ent = cache.get(rel, st) if cache else None
+        if ent is None:
+            with open(fp, "r", encoding="utf-8") as fh:
+                text = fh.read()
+            file_findings, summary = analyze_source(text, rel)
+            if cache:
+                cache.put(
+                    rel, st, summary, [f.to_cache() for f in file_findings]
+                )
+        else:
+            file_findings = [Finding.from_cache(d) for d in ent["findings"]]
+            summary = ent["summary"]
+        findings.extend(file_findings)
+        if summary is not None:
+            summaries.append(summary)
+    if cache:
+        cache.save()
+    return findings, summaries
+
+
+def build_graph(paths: Sequence[str], use_cache: bool = True):
+    """Whole-repo call graph + effects (the ``--graph`` entry point)."""
+    from . import callgraph
+
+    _, summaries = collect(paths, use_cache=use_cache)
+    return callgraph.build_project(summaries)
+
+
 def run(
     paths: Sequence[str],
     baseline_path: Optional[str] = DEFAULT_BASELINE,
+    use_cache: bool = True,
 ) -> Tuple[List[Finding], int]:
     """Lint files; returns (non-baselined findings, baselined count).
 
     Baselined findings are matched per (path, rule) in line order, so a
     grandfathered file fails again only when it grows NEW findings."""
-    all_findings: List[Finding] = []
-    for fp in iter_py_files(paths):
-        with open(fp, "r", encoding="utf-8") as fh:
-            text = fh.read()
-        all_findings.extend(check_source(text, _rel(fp)))
+    from . import callgraph
+
+    all_findings, summaries = collect(paths, use_cache=use_cache)
+    project = callgraph.build_project(summaries)
+    for rule in RULES.values():
+        if isinstance(rule, ProjectRule):
+            all_findings.extend(rule.check_project(project))
     budget = dict(load_baseline(baseline_path) if baseline_path else {})
     fresh: List[Finding] = []
     baselined = 0

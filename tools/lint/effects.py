@@ -1,0 +1,409 @@
+"""Per-function effect inference + fixpoint propagation over the call
+graph, and the mtime-keyed summary cache that keeps whole-repo lint fast.
+
+Effect vocabulary (a function's *direct* effects, from its own body):
+
+  blocks         event-loop-hostile work: a known blocking primitive
+                 (time.sleep, sync HTTP, subprocess, sync sockets) or a
+                 threading.Lock acquisition (contended, it parks the
+                 whole loop, not just this task)
+  host-sync      device->host transfer (.tolist()/.item(), float/int/
+                 bool/np.asarray on a device value)
+  awaits         body contains an await
+  mutates-shared writes self.* attributes or declared-global names
+  acquires-lock  takes any lock (threading or asyncio)
+
+``propagate`` closes ``blocks`` and ``host-sync`` transitively over the
+resolved call graph: an effect inherited through an edge remembers that
+edge as its *witness*, so every interprocedural finding can report the
+concrete call chain down to the primitive that proves it
+(``chain_for``).  Propagation follows an edge only when the callee
+actually runs inline — sync callees always, async callees only when
+awaited — so a coroutine merely scheduled with create_task doesn't leak
+its effects into the caller (it is its own graph node and gets its own
+findings).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import REPO_ROOT, dotted_name, unparse, walk_tree
+
+# word-boundary match for lock-named objects ("lock", "self._lock",
+# "db_lock", "rlock") that does NOT hit embedded substrings such as
+# "block" — 'block'[1:] == 'lock', so a plain `in` test misfires
+_LOCKISH_NAME = re.compile(r"(?:^|[^a-z0-9])r?lock")
+
+
+def lockish_name(text: Optional[str]) -> bool:
+    return bool(_LOCKISH_NAME.search((text or "").lower()))
+
+# canonical blocking-primitive table (rules_async imports this)
+BLOCKING_CALLS = {
+    "time.sleep": "await asyncio.sleep(...)",
+    "requests.get": "an async client or run_in_executor",
+    "requests.post": "an async client or run_in_executor",
+    "requests.put": "an async client or run_in_executor",
+    "requests.delete": "an async client or run_in_executor",
+    "requests.head": "an async client or run_in_executor",
+    "requests.request": "an async client or run_in_executor",
+    "urllib.request.urlopen": "an async client or run_in_executor",
+    "subprocess.run": "asyncio.create_subprocess_exec",
+    "subprocess.call": "asyncio.create_subprocess_exec",
+    "subprocess.check_call": "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "asyncio.create_subprocess_exec",
+    "socket.create_connection": "asyncio.open_connection",
+    "socket.getaddrinfo": "loop.getaddrinfo",
+}
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock"}
+
+PROPAGATED = ("blocks", "host-sync")
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> canonical dotted prefix, so `from time import sleep`
+    and `import time as t` still resolve to time.sleep."""
+    aliases: Dict[str, str] = {}
+    for node in walk_tree(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+class ModuleEffectContext:
+    """Module-scoped taint needed to judge one function's body: import
+    aliases, device-value names (rules_jax), and threading-lock names."""
+
+    __slots__ = ("aliases", "device_aliases", "device_tainted",
+                 "class_locks", "module_locks")
+
+    def __init__(self, tree: ast.Module):
+        from .rules_jax import _device_taint
+
+        self.aliases = import_aliases(tree)
+        self.device_aliases, self.device_tainted = _device_taint(tree)
+        self.class_locks: Set[Tuple[str, str]] = set()  # (class qname, attr)
+        self.module_locks: Set[str] = set()
+        self._collect_locks(tree)
+
+    def canon(self, dn: Optional[str]) -> Optional[str]:
+        if not dn:
+            return dn
+        head, _, rest = dn.partition(".")
+        full = self.aliases.get(head)
+        if full:
+            return full + ("." + rest if rest else "")
+        return dn
+
+    def _collect_locks(self, tree: ast.Module) -> None:
+        # `self._lock = threading.Lock()` inside any method taints
+        # (ClassQname, "_lock"); lock-ctor assignments are rare, so the
+        # class context comes from the parent chain per hit
+        for node in walk_tree(tree):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            ctor = self.canon(dotted_name(node.value.func))
+            if ctor not in _LOCK_CTORS:
+                continue
+            classes: List[str] = []
+            cur = getattr(node, "_ll_parent", None)
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    classes.append(cur.name)
+                cur = getattr(cur, "_ll_parent", None)
+            qname = ".".join(reversed(classes))
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.module_locks.add(t.id)
+                elif (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and qname
+                ):
+                    self.class_locks.add((qname, t.attr))
+
+    def is_thread_lock(self, expr: ast.AST, cls: Optional[str]) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.module_locks
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            return (cls, expr.attr) in self.class_locks
+        return False
+
+
+def module_effect_context(tree: ast.Module) -> ModuleEffectContext:
+    """Memoized on the tree object: summary extraction and several rules
+    all need the same module taint, and building it walks the whole
+    tree."""
+    ctx = getattr(tree, "_ll_effect_ctx", None)
+    if ctx is None:
+        ctx = ModuleEffectContext(tree)
+        tree._ll_effect_ctx = ctx  # type: ignore[attr-defined]
+    return ctx
+
+
+def direct_effects(
+    own: Sequence[ast.AST],
+    ctx: ModuleEffectContext,
+    cls: Optional[str] = None,
+    globals_decl: Optional[Set[str]] = None,
+) -> Dict[str, dict]:
+    """Direct effect set of one function body (nested defs excluded by
+    the caller via callgraph.walk_own).  Each effect records its first
+    witness site: {"line": n, "detail": str}."""
+    out: Dict[str, dict] = {}
+    globals_decl = globals_decl or set()
+
+    def add(eff: str, node: ast.AST, detail: str) -> None:
+        if eff not in out:
+            out[eff] = {"line": getattr(node, "lineno", 1), "detail": detail}
+
+    def is_device_value(node: ast.AST) -> bool:
+        from .rules_jax import _is_device_producer
+
+        if isinstance(node, ast.Name) and node.id in ctx.device_tainted:
+            return True
+        return _is_device_producer(node, ctx.device_aliases)
+
+    for node in own:
+        if isinstance(node, ast.Await):
+            add("awaits", node, "await")
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if ctx.is_thread_lock(ce, cls):
+                    add("blocks", node, f"acquires threading lock {unparse(ce)}")
+                    add("acquires-lock", node, f"with {unparse(ce)}")
+                elif lockish_name(unparse(ce)):
+                    add("acquires-lock", node, f"with {unparse(ce)}")
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    add("mutates-shared", node, f"writes self.{t.attr}")
+                elif isinstance(t, ast.Name) and t.id in globals_decl:
+                    add("mutates-shared", node, f"writes global {t.id}")
+        elif isinstance(node, ast.Call):
+            dn = ctx.canon(dotted_name(node.func))
+            if dn in BLOCKING_CALLS:
+                add("blocks", node, f"{dn}()")
+                continue
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "acquire" and ctx.is_thread_lock(
+                    node.func.value, cls
+                ):
+                    add(
+                        "blocks", node,
+                        f"acquires threading lock {unparse(node.func.value)}",
+                    )
+                    add("acquires-lock", node, f"{unparse(node.func.value)}.acquire()")
+                    continue
+                if node.func.attr in ("tolist", "item") and not node.args:
+                    add(
+                        "host-sync", node,
+                        f".{node.func.attr}() forces a device->host transfer",
+                    )
+                    continue
+            is_cast = isinstance(node.func, ast.Name) and node.func.id in (
+                "float", "int", "bool",
+            )
+            is_np_pull = dn in (
+                "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+            )
+            if (
+                (is_cast or is_np_pull)
+                and len(node.args) >= 1
+                and is_device_value(node.args[0])
+            ):
+                what = dn or node.func.id  # type: ignore[union-attr]
+                add("host-sync", node, f"{what}(...) pulls a device value to host")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fixpoint over the call graph
+# ---------------------------------------------------------------------------
+
+
+def _edge_executes(project, edge) -> bool:
+    callee = project.funcs.get(edge.callee)
+    if callee is None:
+        return False
+    if callee.is_async and not edge.awaited:
+        # merely scheduled (create_task) or forgotten: the coroutine is
+        # its own graph node; its effects don't run inline here
+        return False
+    return True
+
+
+def propagate(project) -> None:
+    """Close PROPAGATED effects over executing call edges.  Monotone set
+    growth over a finite lattice: terminates on any cycle."""
+    inherited: Dict[str, Dict[str, object]] = {fq: {} for fq in project.funcs}
+    changed = True
+    while changed:
+        changed = False
+        for fq, fn in project.funcs.items():
+            for edge in fn.edges:
+                if not _edge_executes(project, edge):
+                    continue
+                callee = project.funcs[edge.callee]
+                for eff in PROPAGATED:
+                    if eff in fn.effects or eff in inherited[fq]:
+                        continue
+                    if eff in callee.effects or eff in inherited[edge.callee]:
+                        inherited[fq][eff] = edge
+                        changed = True
+    project.inherited = inherited
+
+
+def chain_for(project, fq: str, eff: str) -> List[str]:
+    """Witness chain 'path:line qualname' frames from ``fq`` down to the
+    direct site of ``eff`` (terminal frame carries the detail)."""
+    frames: List[str] = []
+    seen: Set[str] = set()
+    cur = fq
+    while cur not in seen:
+        seen.add(cur)
+        fn = project.funcs.get(cur)
+        if fn is None:
+            break
+        if eff in fn.effects:
+            ev = fn.effects[eff]
+            frames.append(f"{fn.path}:{ev['line']} {cur} [{ev['detail']}]")
+            break
+        edge = project.inherited.get(cur, {}).get(eff)
+        if edge is None:
+            break
+        frames.append(f"{fn.path}:{edge.line} {cur}")
+        cur = edge.callee
+    return frames
+
+
+def root_site(project, fq: str, eff: str) -> Optional[Tuple[str, int]]:
+    """(path, line) of the direct effect site a chain terminates at."""
+    seen: Set[str] = set()
+    cur = fq
+    while cur not in seen:
+        seen.add(cur)
+        fn = project.funcs.get(cur)
+        if fn is None:
+            return None
+        if eff in fn.effects:
+            return (fn.path, fn.effects[eff]["line"])
+        edge = project.inherited.get(cur, {}).get(eff)
+        if edge is None:
+            return None
+        cur = edge.callee
+    return None
+
+
+# ---------------------------------------------------------------------------
+# summary cache
+# ---------------------------------------------------------------------------
+
+_CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".cache.json")
+_CACHE_VERSION = 1
+
+
+def _lint_stamp() -> str:
+    """Fingerprint of the analyzer itself: any rule/engine edit
+    invalidates every cached summary and finding."""
+    d = os.path.dirname(os.path.abspath(__file__))
+    parts = []
+    for fn in sorted(os.listdir(d)):
+        if fn.endswith(".py"):
+            st = os.stat(os.path.join(d, fn))
+            parts.append(f"{fn}:{st.st_mtime_ns}:{st.st_size}")
+    return "|".join(parts)
+
+
+class SummaryCache:
+    """Per-file (mtime, size)-keyed cache of ModuleSummary + per-file
+    findings, so an unchanged file is neither re-parsed nor re-linted.
+    Interprocedural analysis re-runs every time (it is whole-program by
+    nature) but consumes only summaries, which is cheap."""
+
+    def __init__(self, path: str = _CACHE_PATH, root: Optional[str] = None):
+        self.path = path
+        self.root = root or REPO_ROOT  # entry paths resolve against this
+        self.stamp = _lint_stamp()
+        self.entries: Dict[str, dict] = {}
+        self.dirty = False
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+            if (
+                data.get("version") == _CACHE_VERSION
+                and data.get("stamp") == self.stamp
+            ):
+                self.entries = data.get("entries", {})
+        except (OSError, ValueError):
+            pass
+
+    def get(self, rel: str, st: os.stat_result) -> Optional[dict]:
+        e = self.entries.get(rel)
+        if e and e["mtime"] == st.st_mtime_ns and e["size"] == st.st_size:
+            return e
+        return None
+
+    def put(
+        self, rel: str, st: os.stat_result, summary: Optional[dict],
+        findings: List[dict],
+    ) -> None:
+        self.entries[rel] = {
+            "mtime": st.st_mtime_ns,
+            "size": st.st_size,
+            "summary": summary,
+            "findings": findings,
+        }
+        self.dirty = True
+
+    def save(self) -> None:
+        # drop entries for files that were deleted/renamed since the last
+        # run, or the cache grows monotonically across refactors.  Only
+        # vanished files are pruned — a scoped `lint a.py` run must keep
+        # the rest of the repo's summaries warm.
+        for rel in [
+            r for r in self.entries
+            if not os.path.exists(os.path.join(self.root, r))
+        ]:
+            del self.entries[rel]
+            self.dirty = True
+        if not self.dirty:
+            return
+        try:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(
+                    {
+                        "version": _CACHE_VERSION,
+                        "stamp": self.stamp,
+                        "entries": self.entries,
+                    },
+                    fh,
+                )
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # cache is best-effort; lint correctness never depends on it

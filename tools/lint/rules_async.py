@@ -12,6 +12,7 @@ import ast
 from typing import List, Optional, Set
 
 from .core import (
+    walk_tree,
     Finding,
     Rule,
     dotted_name,
@@ -89,7 +90,7 @@ class SwallowedCancel(Rule):
 
     def check(self, tree, text, path) -> List[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if not isinstance(node, ast.Try):
                 continue
             func = nearest_function(node)
@@ -125,7 +126,7 @@ class GatherNoReturnExceptions(Rule):
 
     def check(self, tree, text, path) -> List[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if not isinstance(node, ast.Call):
                 continue
             dn = dotted_name(node.func)
@@ -180,7 +181,7 @@ class TaskNoRef(Rule):
             "task reference discarded; retain it (e.g. a task set with "
             "add_done_callback(set.discard)) or await it"
         )
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if isinstance(node, ast.Expr) and self._is_factory_call(node.value):
                 out.append(self.finding(path, node, msg))
             elif (
@@ -194,37 +195,11 @@ class TaskNoRef(Rule):
         return out
 
 
-_BLOCKING_CALLS = {
-    "time.sleep": "await asyncio.sleep(...)",
-    "requests.get": "an async client or run_in_executor",
-    "requests.post": "an async client or run_in_executor",
-    "requests.put": "an async client or run_in_executor",
-    "requests.delete": "an async client or run_in_executor",
-    "requests.head": "an async client or run_in_executor",
-    "requests.request": "an async client or run_in_executor",
-    "urllib.request.urlopen": "an async client or run_in_executor",
-    "subprocess.run": "asyncio.create_subprocess_exec",
-    "subprocess.call": "asyncio.create_subprocess_exec",
-    "subprocess.check_call": "asyncio.create_subprocess_exec",
-    "subprocess.check_output": "asyncio.create_subprocess_exec",
-    "socket.create_connection": "asyncio.open_connection",
-    "socket.getaddrinfo": "loop.getaddrinfo",
-}
-
-
-def _import_aliases(tree: ast.Module) -> dict:
-    """Local name -> canonical dotted prefix, so `from time import sleep`
-    and `import time as t` still resolve to time.sleep."""
-    aliases = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.asname:
-                    aliases[a.asname] = a.name
-        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
-            for a in node.names:
-                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
-    return aliases
+# canonical blocking-primitive table + alias resolution live in
+# effects.py now — the interprocedural engine and this per-file rule
+# must agree on what "blocking" means
+from .effects import BLOCKING_CALLS as _BLOCKING_CALLS
+from .effects import import_aliases as _import_aliases
 
 
 @register
@@ -237,9 +212,11 @@ class BlockingAsync(Rule):
     )
 
     def check(self, tree, text, path) -> List[Finding]:
+        from .effects import module_effect_context
+
         out: List[Finding] = []
-        aliases = _import_aliases(tree)
-        for node in ast.walk(tree):
+        aliases = module_effect_context(tree).aliases
+        for node in walk_tree(tree):
             if not isinstance(node, ast.Call):
                 continue
             if not isinstance(nearest_function(node), ast.AsyncFunctionDef):

@@ -1,0 +1,534 @@
+"""Interprocedural rules: the cross-function defect class hand review
+keeps missing (ISSUE 4) — a sync helper that blocks the event loop three
+calls below an ``async def``, a host-sync buried in a util reachable
+from the jitted verify path, and read-modify-write of shared service
+state interleaved across an ``await``.
+
+The two ``ProjectRule`` subclasses consume the repo-wide call graph +
+effect fixpoint (tools/lint/callgraph.py, tools/lint/effects.py) and
+report the concrete call chain that proves reachability; the rest are
+per-file rules that need only one function's AST.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    walk_tree,
+    Finding,
+    ProjectRule,
+    Rule,
+    dotted_name,
+    nearest_function,
+    parent_chain,
+    register,
+    unparse,
+)
+from .effects import chain_for, lockish_name, module_effect_context, root_site
+from .rules_jax import _HOT_PATH_PREFIXES
+
+
+def _short(fq: str) -> str:
+    return fq.split(":", 1)[-1]
+
+
+class _ChainRule(ProjectRule):
+    """Shared plumbing: emit a finding for an inherited effect with its
+    witness chain, honoring suppressions at the anchor AND root site."""
+
+    effect = ""
+
+    def _emit(self, project, fn, edge, message: str) -> Optional[Finding]:
+        root = root_site(project, fn.fq, self.effect)
+        if project.suppressed(fn.path, edge.line, self.id):
+            return None
+        if root and project.suppressed(root[0], root[1], self.id):
+            return None
+        return Finding(
+            path=fn.path,
+            line=edge.line,
+            col=edge.col,
+            rule=self.id,
+            message=message,
+            effects=(self.effect,),
+            chain=tuple(chain_for(project, fn.fq, self.effect)),
+        )
+
+
+@register
+class TransitiveBlocking(_ChainRule):
+    id = "transitive-blocking"
+    effect = "blocks"
+    description = (
+        "a blocking primitive (time.sleep, sync HTTP, subprocess, "
+        "threading-lock acquire) reachable from an async def through any "
+        "call chain: the event loop stalls even though no blocking call "
+        "is visible in the coroutine itself.  Supersedes blocking-async "
+        "for depth; the reported chain names every hop down to the "
+        "primitive"
+    )
+
+    def check_project(self, project) -> List[Finding]:
+        out: List[Finding] = []
+        for fq in sorted(project.funcs):
+            fn = project.funcs[fq]
+            if not fn.is_async or fn.path.startswith("tests/"):
+                continue
+            if "blocks" in fn.effects:
+                continue  # direct call: blocking-async's per-file territory
+            edge = project.inherited.get(fq, {}).get("blocks")
+            if edge is None:
+                continue
+            f = self._emit(
+                project, fn, edge,
+                f"async def {_short(fq)} blocks the event loop via "
+                f"{_short(edge.callee)}() — see the call chain; make the "
+                "helper async, or dispatch it with run_in_executor",
+            )
+            if f:
+                out.append(f)
+        return out
+
+
+@register
+class TransitiveHostSync(_ChainRule):
+    id = "transitive-host-sync"
+    effect = "host-sync"
+    description = (
+        "a device->host sync reachable from a verify hot-path function "
+        "(lodestar_tpu/ops/, chain/bls/, crypto/bls/) through a call "
+        "chain that leaves the hot path — the stall host-sync can't see "
+        "because the .tolist()/float() lives in a util module.  Findings "
+        "anchor at the hot-path call site where control leaves the hot "
+        "path and carry the full chain"
+    )
+
+    def check_project(self, project) -> List[Finding]:
+        out: List[Finding] = []
+        for fq in sorted(project.funcs):
+            fn = project.funcs[fq]
+            if not fn.path.startswith(_HOT_PATH_PREFIXES):
+                continue
+            if "host-sync" in fn.effects:
+                continue  # direct sync in a hot file: host-sync flags the site
+            edge = project.inherited.get(fq, {}).get("host-sync")
+            if edge is None:
+                continue
+            callee = project.funcs.get(edge.callee)
+            if callee is not None and callee.path.startswith(_HOT_PATH_PREFIXES):
+                continue  # boundary belongs to the inner hot function
+            f = self._emit(
+                project, fn, edge,
+                f"hot-path {_short(fq)} reaches a device->host sync via "
+                f"{_short(edge.callee)}() outside the hot path; keep the "
+                "value on device or move the one deliberate sync to the "
+                "API boundary with a suppression + reason",
+            )
+            if f:
+                out.append(f)
+        return out
+
+
+@register
+class UnawaitedCoro(ProjectRule):
+    id = "unawaited-coro"
+    description = (
+        "calling a known-async function without await/create_task/"
+        "gather and discarding the result: the coroutine object is "
+        "built, never scheduled, and dies with a RuntimeWarning at GC "
+        "time — the work silently never happens"
+    )
+
+    def check_project(self, project) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[Tuple[str, int, int]] = set()
+        for fq in sorted(project.funcs):
+            fn = project.funcs[fq]
+            for edge in fn.edges:
+                callee = project.funcs.get(edge.callee)
+                if callee is None or not callee.is_async:
+                    continue
+                if edge.awaited or edge.wrapped or not edge.discarded:
+                    continue
+                key = (fn.path, edge.line, edge.col)
+                if key in seen:
+                    continue  # protocol dispatch: one finding per site
+                seen.add(key)
+                if project.suppressed(fn.path, edge.line, self.id):
+                    continue
+                out.append(
+                    Finding(
+                        path=fn.path,
+                        line=edge.line,
+                        col=edge.col,
+                        rule=self.id,
+                        message=(
+                            f"{_short(edge.callee)}() is async but the "
+                            "coroutine is neither awaited nor scheduled; "
+                            "await it or wrap in asyncio.create_task"
+                        ),
+                        effects=("unawaited",),
+                        chain=(
+                            f"{callee.path}:{callee.line} {edge.callee} "
+                            "[async def]",
+                        ),
+                    )
+                )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# per-file rules (need one function's AST, not the graph)
+# ---------------------------------------------------------------------------
+
+
+def _async_with_locks(node: ast.AST, func: ast.AST) -> Set[int]:
+    """ids of enclosing AsyncWith statements that look like lock guards
+    (context expr mentions 'lock'), up to the function boundary."""
+    out: Set[int] = set()
+    for child, parent, field in parent_chain(node):
+        if parent is func:
+            break
+        if isinstance(parent, ast.AsyncWith) and field == "body":
+            if any(
+                lockish_name(unparse(i.context_expr)) for i in parent.items
+            ):
+                out.add(id(parent))
+    return out
+
+
+def _if_arms(node: ast.AST, func: ast.AST) -> Dict[int, str]:
+    """Map id(enclosing If/IfExp) -> arm field ('body'/'orelse') for each
+    conditional ancestor up to the function boundary."""
+    arms: Dict[int, str] = {}
+    for child, parent, field in parent_chain(node):
+        if parent is func:
+            break
+        if isinstance(parent, (ast.If, ast.IfExp)) and field in (
+            "body",
+            "orelse",
+        ):
+            # only the taken/untaken arms are exclusive; a node in the
+            # `test` executes with BOTH arms (check-then-act across an
+            # await must still pair with writes in either arm)
+            arms[id(parent)] = field
+    return arms
+
+
+def _exclusive_branches(a: Dict[int, str], b: Dict[int, str]) -> bool:
+    """True when the two nodes sit in different arms of a shared If —
+    they can never execute in the same call, so no race between them."""
+    return any(b.get(k, fa) != fa for k, fa in a.items())
+
+
+def _own_nodes(func: ast.AST):
+    from .callgraph import walk_own
+
+    return list(walk_own(func))
+
+
+@register
+class AwaitInCritical(Rule):
+    id = "await-in-critical"
+    description = (
+        "asyncio race: shared state (self.* / declared global) read "
+        "before an await and written after it, with no asyncio.Lock "
+        "held across the sequence — another task interleaves at the "
+        "await and the write clobbers its update (read-modify-write on "
+        "stale state).  Constant writes (flag resets) are exempt"
+    )
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        for func in walk_tree(tree):
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            own = _own_nodes(func)
+            globals_decl: Set[str] = set()
+            for n in own:
+                if isinstance(n, ast.Global):
+                    globals_decl.update(n.names)
+
+            def slot_of(n: ast.AST) -> Optional[str]:
+                if (
+                    isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"
+                ):
+                    return f"self.{n.attr}"
+                if isinstance(n, ast.Name) and n.id in globals_decl:
+                    return n.id
+                return None
+
+            reads: Dict[str, List[ast.AST]] = {}
+            writes: List[Tuple[str, ast.AST, ast.AST]] = []  # (slot, target, stmt)
+            awaits: List[ast.AST] = []
+            for n in own:
+                if isinstance(n, ast.Await):
+                    awaits.append(n)
+                elif isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    value = n.value
+                    if value is None or isinstance(value, ast.Constant):
+                        # resets/flags are idempotent, not a race; a
+                        # constant-operand AugAssign re-reads atomically
+                        # at store time (no await can split it)
+                        continue
+                    targets = (
+                        n.targets if isinstance(n, ast.Assign) else [n.target]
+                    )
+                    for t in targets:
+                        slot = slot_of(t)
+                        if slot:
+                            writes.append((slot, t, n))
+                slot = slot_of(n)
+                if slot and isinstance(getattr(n, "ctx", None), ast.Load):
+                    reads.setdefault(slot, []).append(n)
+
+            if not awaits or not writes:
+                continue
+            pos = lambda n: (n.lineno, n.col_offset)  # noqa: E731
+            # an await nested in a write's value commits the store AFTER
+            # the yield — the (line, col) ordering below can't see it
+            # because read/await/write share the statement's position
+            value_awaits: Dict[int, ast.AST] = {}
+            for slot, target, stmt in writes:
+                a = next(
+                    (
+                        n
+                        for n in ast.walk(stmt.value)
+                        if isinstance(n, ast.Await)
+                    ),
+                    None,
+                )
+                if a is not None:
+                    value_awaits[id(stmt)] = a
+            flagged: Set[int] = set()
+            # intra-statement RMW: `self.x += await g()` and
+            # `self.x = self.x + await g()` read the slot, yield at the
+            # await inside the value, then store the stale-derived result
+            for slot, target, stmt in writes:
+                a = value_awaits.get(id(stmt))
+                if a is None:
+                    continue
+                rmw = isinstance(stmt, ast.AugAssign) or any(
+                    slot_of(v) == slot
+                    and isinstance(getattr(v, "ctx", None), ast.Load)
+                    for v in ast.walk(stmt.value)
+                )
+                if not rmw or _async_with_locks(stmt, func):
+                    continue
+                flagged.add(id(stmt))
+                out.append(
+                    self.finding(
+                        path,
+                        stmt,
+                        f"{slot} is read and re-written by this one "
+                        f"statement with an await in between (the value "
+                        f"awaits on line {a.lineno}): the task yields "
+                        "mid read-modify-write and an interleaved "
+                        "task's update is lost.  Hold an asyncio.Lock "
+                        "across the sequence",
+                    )
+                )
+            for slot, target, stmt in writes:
+                if id(stmt) in flagged or isinstance(stmt, ast.AugAssign):
+                    # AugAssign without an await in its value re-reads
+                    # atomically at store time; only the intra-statement
+                    # case above is a race
+                    continue
+                t_arms = _if_arms(target, func)
+                for r in reads.get(slot, []):
+                    r_arms = _if_arms(r, func)
+                    if _exclusive_branches(r_arms, t_arms):
+                        continue  # if/else arms: never the same execution
+                    hit = next(
+                        (
+                            a
+                            for a in awaits
+                            if pos(r) < pos(a) < pos(target)
+                            and not _exclusive_branches(
+                                _if_arms(a, func), r_arms
+                            )
+                            and not _exclusive_branches(
+                                _if_arms(a, func), t_arms
+                            )
+                        ),
+                        None,
+                    )
+                    if hit is None:
+                        # write whose value awaits: the store commits
+                        # after the yield even though read and target
+                        # positions don't bracket the await
+                        a = value_awaits.get(id(stmt))
+                        if a is not None and pos(r) < pos(stmt):
+                            hit = a
+                    if hit is None:
+                        continue
+                    guarded = (
+                        _async_with_locks(r, func)
+                        & _async_with_locks(hit, func)
+                        & _async_with_locks(target, func)
+                    )
+                    if guarded:
+                        continue
+                    out.append(
+                        self.finding(
+                            path,
+                            stmt,
+                            f"{slot} is read at line {r.lineno}, the task "
+                            f"yields at the await on line {hit.lineno}, and "
+                            f"{slot} is written here: an interleaved task's "
+                            "update is lost.  Hold an asyncio.Lock across "
+                            "the sequence or re-read after the await",
+                        )
+                    )
+                    break
+        return out
+
+
+@register
+class LockDiscipline(Rule):
+    id = "lock-discipline"
+    description = (
+        "lock hygiene: (a) bare .acquire() on a lock with no try/finally "
+        "release — an exception leaks the lock and every later waiter "
+        "deadlocks; (b) a threading.Lock acquired inside async def "
+        "(worst: held across an await) — a contended sync lock parks the "
+        "whole event loop, not just this task; use asyncio.Lock or "
+        "run_in_executor"
+    )
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        ctx = module_effect_context(tree)
+
+        def enclosing_class_qname(node: ast.AST) -> Optional[str]:
+            names = [
+                parent.name
+                for _, parent, _ in parent_chain(node)
+                if isinstance(parent, ast.ClassDef)
+            ]
+            return ".".join(reversed(names)) if names else None
+
+        def lockish(expr: ast.AST, cls: Optional[str]) -> bool:
+            return ctx.is_thread_lock(expr, cls) or lockish_name(unparse(expr))
+
+        def releases_in_finally(t: ast.Try, obj: str) -> bool:
+            return any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "release"
+                and unparse(n.func.value) == obj
+                for fin in t.finalbody
+                for n in ast.walk(fin)
+            )
+
+        def protected_by_finally(acq: ast.stmt, obj: str) -> bool:
+            # the releasing try/finally must actually guard THIS acquire:
+            # either it encloses it (body/handlers/orelse — anything but
+            # the finalbody itself) or it is the immediately following
+            # sibling statement.  A well-formed pair elsewhere in the same
+            # function must not mask a leaked acquire.
+            parent = getattr(acq, "_ll_parent", None)
+            if parent is not None:
+                for _, value in ast.iter_fields(parent):
+                    if isinstance(value, list) and acq in value:
+                        i = value.index(acq)
+                        if (
+                            i + 1 < len(value)
+                            and isinstance(value[i + 1], ast.Try)
+                            and releases_in_finally(value[i + 1], obj)
+                        ):
+                            return True
+            for _, par, field in parent_chain(acq):
+                if (
+                    isinstance(par, ast.Try)
+                    and field != "finalbody"
+                    and releases_in_finally(par, obj)
+                ):
+                    return True
+            return False
+
+        # (a) bare .acquire() without a try/finally release of the same obj
+        for node in walk_tree(tree):
+            if not (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "acquire"
+            ):
+                continue
+            obj = unparse(node.value.func.value)
+            cls = enclosing_class_qname(node)
+            if not lockish(node.value.func.value, cls):
+                continue
+            if not protected_by_finally(node, obj):
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        f"{obj}.acquire() without a try/finally "
+                        f"{obj}.release(); an exception leaks the lock — "
+                        f"use `with {obj}:`",
+                    )
+                )
+
+        # (b) threading lock taken inside async def — via `with lock:` or
+        # a direct lock.acquire() call (the form every other rule misses:
+        # blocking-async only knows the BLOCKING_CALLS table, and
+        # transitive-blocking defers direct effects to per-file rules)
+        def flag_async_lock(
+            lock_expr: ast.AST, anchor: ast.AST, verb: str,
+            held_across_await: bool,
+        ) -> None:
+            detail = (
+                "and held across an await — every task waits behind it"
+                if held_across_await
+                else "— a contended acquire parks the whole event loop"
+            )
+            out.append(
+                self.finding(
+                    path,
+                    anchor,
+                    f"threading lock {unparse(lock_expr)} {verb} inside "
+                    f"async def {detail}; use asyncio.Lock or move the "
+                    "work to run_in_executor",
+                )
+            )
+
+        for node in walk_tree(tree):
+            is_acquire_call = (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            )
+            if not (isinstance(node, ast.With) or is_acquire_call):
+                continue
+            func = nearest_function(node)
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            cls = enclosing_class_qname(node)
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if not ctx.is_thread_lock(item.context_expr, cls):
+                        continue
+                    # held across an await = an await anywhere in the
+                    # with body (the lock is released at block exit)
+                    flag_async_lock(
+                        item.context_expr, node, "acquired",
+                        any(isinstance(n, ast.Await) for n in ast.walk(node)),
+                    )
+            elif is_acquire_call and ctx.is_thread_lock(node.func.value, cls):
+                # a bare .acquire() holds until an explicit release, so
+                # any later await in the whole function counts
+                pos = (node.lineno, node.col_offset)
+                flag_async_lock(
+                    node.func.value, node, ".acquire()'d",
+                    any(
+                        isinstance(n, ast.Await)
+                        and (n.lineno, n.col_offset) > pos
+                        for n in ast.walk(func)
+                    ),
+                )
+        return out

@@ -10,6 +10,7 @@ import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import (
+    walk_tree,
     Finding,
     Rule,
     dotted_name,
@@ -78,7 +79,7 @@ class JitInFunc(Rule):
 
     def check(self, tree, text, path) -> List[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if not (isinstance(node, ast.Call) and _is_jit_construction(node)):
                 continue
             func = nearest_function(node)
@@ -135,7 +136,7 @@ class StaticUnhashable(Rule):
         jitted: Dict[str, Tuple[Set[int], Set[str]]] = {}
 
         # name = jax.jit(fn, static_argnums=...)
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if (
                 isinstance(node, ast.Assign)
                 and len(node.targets) == 1
@@ -176,7 +177,7 @@ class StaticUnhashable(Rule):
                         )
                     )
 
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if not isinstance(node, ast.Call):
                 continue
             if isinstance(node.func, ast.Name) and node.func.id in jitted:
@@ -208,7 +209,7 @@ def _device_taint(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
     false positive is a one-line suppression with a reason."""
     aliases: Set[str] = set()
     tainted: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in walk_tree(tree):
         if not (
             isinstance(node, ast.Assign)
             and len(node.targets) == 1
@@ -246,7 +247,7 @@ class HostSync(Rule):
                 return True
             return _is_device_producer(node, aliases)
 
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if not isinstance(node, ast.Call):
                 continue
             if (
@@ -311,7 +312,7 @@ class BenchSync(Rule):
         aliases, _ = _device_taint(tree)
         funcs = [
             n
-            for n in ast.walk(tree)
+            for n in walk_tree(tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         for func in funcs:

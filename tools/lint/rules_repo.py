@@ -6,7 +6,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set
 
-from .core import Finding, Rule, dotted_name, parent_chain, register, unparse
+from .core import walk_tree, Finding, Rule, dotted_name, parent_chain, register, unparse
 
 
 def _is_elif(child: ast.AST, parent: ast.If) -> bool:
@@ -46,7 +46,7 @@ class FastTierDefault(Rule):
 
     def check(self, tree, text, path) -> List[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if not (isinstance(node, ast.Call) and self._marks_fast(node)):
                 continue
             # walk the FULL chain of enclosing Ifs up to the function
@@ -129,7 +129,7 @@ class MinMinSub(Rule):
     def check(self, tree, text, path) -> List[Finding]:
         out: List[Finding] = []
         aggregated: Dict[str, str] = {}
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if (
                 isinstance(node, ast.Assign)
                 and len(node.targets) == 1
@@ -138,7 +138,7 @@ class MinMinSub(Rule):
                 arg = _aggregate_arg(node.value, {})
                 if arg is not None:
                     aggregated[node.targets[0].id] = arg
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
                 continue
             left = _aggregate_arg(node.left, aggregated)
@@ -173,7 +173,7 @@ class RcSignTest(Rule):
     def check(self, tree, text, path) -> List[Finding]:
         out: List[Finding] = []
         rc_names: Set[str] = set()
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if (
                 isinstance(node, ast.Assign)
                 and len(node.targets) == 1
@@ -191,7 +191,7 @@ class RcSignTest(Rule):
         def is_zero(n: ast.AST) -> bool:
             return isinstance(n, ast.Constant) and n.value == 0
 
-        for node in ast.walk(tree):
+        for node in walk_tree(tree):
             if not isinstance(node, ast.Compare) or len(node.ops) != 1:
                 continue
             if not isinstance(node.ops[0], _SIGN_OPS):
