@@ -1,0 +1,26 @@
+"""AOT compile-lifecycle subsystem: compile once, run forever.
+
+The BLS pairing programs cost minutes-to-hours of XLA compile on a cold
+cache (BENCH r3-r5 banked 0.0 sigs/s purely on cold compiles), while a
+warm persistent cache loads them in seconds.  This package makes that
+lifecycle a first-class subsystem instead of four divergent copies of
+``jax_compilation_cache_dir`` setup:
+
+- ``aot.cache``     — the ONE ``configure()`` every entry point uses
+                      (node startup, bench, tests, __graft_entry__,
+                      diagnose_cache), plus a persistent-cache spy for
+                      hit/miss/compile-time observability.
+- ``aot.registry``  — the single source of truth for every jit program
+                      the node can dispatch: explicit (kernel, bucket)
+                      entries with concrete avals.
+- ``aot.warm``      — resumable, per-program warmer + freshness
+                      manifest; ``python -m lodestar_tpu.aot warm
+                      [--check]``.
+
+See docs/AOT.md for the workflow.
+"""
+from __future__ import annotations
+
+# Submodules are imported lazily by callers (``from lodestar_tpu.aot
+# import cache``): this package must stay importable without jax so the
+# bench parent / CLI can reference it before any backend init.

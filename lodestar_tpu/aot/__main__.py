@@ -1,0 +1,127 @@
+"""CLI: ``python -m lodestar_tpu.aot warm [--check]`` — compile the
+registered BLS programs into the persistent cache (resumable), or
+verify they are all present and fresh.
+
+Also reachable as ``lodestar-tpu aot warm|check`` (cli/main.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lodestar_tpu.aot",
+        description="AOT compile-cache tooling for the BLS kernels",
+    )
+    sub = ap.add_subparsers(dest="command")
+    w = sub.add_parser(
+        "warm",
+        help="lower+compile every registered program into the persistent "
+        "cache (resumable: finished programs are banked per-program)",
+    )
+    w.add_argument(
+        "--check",
+        action="store_true",
+        help="verify only: exit 0 iff every registered program is warm "
+        "and the manifest is fresh (no compiles)",
+    )
+    w.add_argument(
+        "--list",
+        action="store_true",
+        help="print the registered programs + their warm state and exit",
+    )
+    w.add_argument(
+        "--scope",
+        choices=["core", "full"],
+        default="core",
+        help="core: what bench + the governed pool dispatch (default); "
+        "full: every direct-call bucket as well",
+    )
+    w.add_argument(
+        "--budget-s",
+        type=float,
+        default=None,
+        help="stop starting new compiles after this many seconds "
+        "(finished programs stay banked)",
+    )
+    w.add_argument("--cache-dir", default=None, help="override .jax_cache path")
+    w.add_argument(
+        "--no-export",
+        action="store_true",
+        help="skip the best-effort jax.export serialization",
+    )
+    w.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+    if args.command != "warm":
+        ap.print_help()
+        return 1
+
+    # The persistent-cache key includes compile options: pin the env the
+    # same way bench.py pins its child stages, BEFORE jax initializes,
+    # so warm and bench compute identical keys.
+    from lodestar_tpu.aot import cache as aot_cache
+
+    aot_cache.pin_cache_key_env()
+
+    from lodestar_tpu.aot import registry, warm
+
+    programs = registry.registered_programs(scope=args.scope)
+
+    if args.check or args.list:
+        ok, rows = warm.check_programs(programs, cache_dir=args.cache_dir)
+        if args.json:
+            print(json.dumps({"ok": ok, "programs": dict(rows)}, indent=2))
+        else:
+            for key, state in rows:
+                print(f"{state:>8}  {key}")
+            print(
+                f"aot check: {sum(1 for _, s in rows if s == 'warm')}"
+                f"/{len(rows)} programs warm",
+                file=sys.stderr,
+            )
+        return 0 if ok else 1
+
+    # single-warmer lock: two concurrent warms would double-compile the
+    # same 40-minute program
+    import fcntl
+
+    cache_dir = args.cache_dir or aot_cache.repo_cache_dir()
+    import os
+
+    os.makedirs(cache_dir, exist_ok=True)
+    lock_fh = open(os.path.join(cache_dir, ".aot.lock"), "w")
+    try:
+        fcntl.flock(lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        print(
+            "aot warm: another warm run holds the lock — exiting "
+            "(its finished programs will be banked)",
+            file=sys.stderr,
+        )
+        return 3
+    try:
+        report = warm.warm_programs(
+            programs,
+            cache_dir=args.cache_dir,
+            budget_s=args.budget_s,
+            do_export=not args.no_export,
+            log=lambda m: print(m, file=sys.stderr, flush=True),
+        )
+    finally:
+        lock_fh.close()
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(
+            f"aot warm: {len(report['compiled'])} compiled, "
+            f"{len(report['skipped'])} already warm, "
+            f"{len(report['deferred'])} deferred"
+        )
+    return 0 if not report["deferred"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,196 @@
+"""Persistent compilation-cache config + observability spy.
+
+``configure()`` is the single cache-setup path for every entry point.
+Four divergent copies of this logic (bench.py, tests/conftest.py,
+__graft_entry__.py, tools/diagnose_cache.py) previously disagreed on
+defaults while the production node path never enabled the cache at all
+— so first verification on a node paid the full multi-minute compile
+every process start.
+
+``install_cache_spy()`` wraps jax's internal persistent-cache get/put
+(jax._src.compilation_cache.get_executable_and_time /
+put_executable_and_time — both called through module-attribute lookup,
+so wrapping the attributes is effective) to count hits/misses and
+observe real compile times.  The warm tool uses the captured keys to
+learn each program's cache filename; chain/bls/metrics.py feeds the
+events into the Prometheus family.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
+
+# Matches what bench.py historically used: only multi-second compiles
+# are worth a cache entry; tests override to 0.0 for tiny programs.
+DEFAULT_MIN_COMPILE_SECS = 1.0
+
+
+def repo_cache_dir() -> str:
+    """The repo-local persistent cache (override: LODESTAR_TPU_JAX_CACHE)."""
+    return os.environ.get("LODESTAR_TPU_JAX_CACHE", DEFAULT_CACHE_DIR)
+
+
+def configure(
+    cache_dir: Optional[str] = None,
+    *,
+    min_compile_time_secs: float = DEFAULT_MIN_COMPILE_SECS,
+) -> str:
+    """Point jax at the persistent compilation cache.  Idempotent; safe
+    before or after backend init (changing the directory mid-process
+    resets jax's internal cache handle, which otherwise keeps serving
+    the OLD directory).  Returns the cache dir in effect."""
+    import jax
+
+    if os.environ.get("XLA_FLAGS"):
+        # compile options are part of the persistent-cache KEY: a
+        # process running under XLA_FLAGS computes different keys than
+        # the warm tool (which pins its env via pin_cache_key_env), so
+        # warmed entries are invisible and first dispatch compiles
+        # cold.  Warn — don't silently strip: XLA_FLAGS can be a
+        # deliberate operator choice (e.g. the multichip dryrun's
+        # host_platform_device_count).
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "XLA_FLAGS is set: persistent compilation-cache keys will "
+            "not match `python -m lodestar_tpu.aot warm` (which clears "
+            "it) — warmed programs may recompile cold"
+        )
+    cache_dir = cache_dir or repo_cache_dir()
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_time_secs
+    )
+    if prev is not None and prev != cache_dir:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    return cache_dir
+
+
+def pin_cache_key_env(environ: Optional[Dict[str, str]] = None) -> None:
+    """Make the persistent-cache KEY deterministic across invokers by
+    clearing XLA_FLAGS (compile options are part of the key: a cache
+    warmed under a builder shell's stray flags is invisible to the
+    driver's bare ``python bench.py`` — the round-4 failure mode).
+    Call BEFORE the first jax backend use.  Mutates ``environ``
+    (default: os.environ)."""
+    env = environ if environ is not None else os.environ
+    env.pop("XLA_FLAGS", None)
+
+
+# ---------------------------------------------------------------------------
+# persistent-cache spy
+# ---------------------------------------------------------------------------
+
+_spy_lock = threading.Lock()
+_SPY: Dict[str, object] = {"installed": False}
+_CALLBACKS: List[Callable[[str, str, float], None]] = []
+_STATS = {"hits": 0, "misses": 0, "puts": 0}
+_KEYS: Dict[str, str] = {}  # cache_key -> last event kind
+
+
+def install_cache_spy(
+    callback: Optional[Callable[[str, str, float], None]] = None,
+) -> None:
+    """Wrap the persistent-cache read/write path.  ``callback`` (if
+    given) receives (kind, cache_key, seconds) with kind in
+    {"hit", "miss", "put"}; seconds is the stored/observed compile time
+    (0.0 on miss).  Idempotent: the wrappers install once per process,
+    callbacks accumulate."""
+    with _spy_lock:
+        if callback is not None:
+            _CALLBACKS.append(callback)
+        if _SPY["installed"]:
+            return
+        from jax._src import compilation_cache as cc
+
+        orig_get = cc.get_executable_and_time
+        orig_put = cc.put_executable_and_time
+
+        def spy_get(cache_key, *args, **kwargs):
+            executable, compile_time = orig_get(cache_key, *args, **kwargs)
+            if executable is not None:
+                _emit("hit", cache_key, float(compile_time or 0))
+            else:
+                _emit("miss", cache_key, 0.0)
+            return executable, compile_time
+
+        def spy_put(cache_key, *args, **kwargs):
+            # signature: (cache_key, module_name, executable, backend,
+            # compile_time:int seconds)
+            seconds = 0.0
+            if args:
+                try:
+                    seconds = float(args[-1])
+                except (TypeError, ValueError):
+                    seconds = 0.0
+            _emit("put", cache_key, seconds)
+            return orig_put(cache_key, *args, **kwargs)
+
+        cc.get_executable_and_time = spy_get
+        cc.put_executable_and_time = spy_put
+        _SPY["installed"] = True
+
+
+def remove_cache_spy_callback(
+    callback: Callable[[str, str, float], None],
+) -> None:
+    """Unregister a callback added by ``install_cache_spy``.  The spy
+    wrappers stay installed (they are process-global and idempotent),
+    but the callback — and whatever it strongly references, e.g. a
+    closed verifier pool — is released."""
+    # Reviewed exception: the lock guards a bare list.remove —
+    # microseconds, never held across I/O or a compile — and the async
+    # caller (DeviceBlsVerifier.close) runs it once at teardown.
+    with _spy_lock:  # lodelint: disable=transitive-blocking
+        try:
+            _CALLBACKS.remove(callback)
+        except ValueError:
+            pass
+
+
+_STAT_KEY = {"hit": "hits", "miss": "misses", "put": "puts"}
+
+
+def _emit(kind: str, cache_key: str, seconds: float) -> None:
+    stat = _STAT_KEY.get(kind, kind)
+    _STATS[stat] = _STATS.get(stat, 0) + 1
+    _KEYS[cache_key] = kind
+    for cb in list(_CALLBACKS):
+        try:
+            cb(kind, cache_key, seconds)
+        except Exception:
+            pass  # a metrics sink must never break a compile
+
+
+def cache_stats() -> Dict[str, int]:
+    """Snapshot of persistent-cache traffic since the spy installed."""
+    return dict(_STATS)
+
+
+def observed_keys() -> Dict[str, str]:
+    """cache_key -> last event kind ("hit"/"miss"/"put")."""
+    return dict(_KEYS)
+
+
+def reset_stats() -> None:
+    for k in list(_STATS):
+        _STATS[k] = 0
+    _KEYS.clear()
+
+
+def entry_exists(cache_dir: str, cache_key: str) -> bool:
+    """True if a persistent-cache entry for ``cache_key`` is on disk
+    (jax's LRU file cache stores ``<key>-cache``; the plain layout
+    stores ``<key>``)."""
+    return os.path.isfile(os.path.join(cache_dir, cache_key + "-cache")) or (
+        os.path.isfile(os.path.join(cache_dir, cache_key))
+    )

@@ -1,0 +1,254 @@
+"""Resumable AOT warmer + freshness manifest.
+
+``warm_programs()`` lowers + compiles each registered program into the
+persistent cache and records it in ``<cache>/warm_manifest.json``, one
+entry per program, SAVED AFTER EVERY PROGRAM: on the 2-core driver host
+a single pairing compile costs ~15-40 minutes, so a warm run killed by
+an external timeout must bank every finished program — the next
+invocation skips them (manifest fresh + cache entry on disk) and picks
+up where it left off.
+
+Manifest freshness is keyed by (backend, jax version, source
+fingerprint): the fingerprint hashes the kernel-relevant sources
+(ops/bls12_381, crypto/bls, aot), so editing a kernel invalidates
+exactly the manifest — never the cache files themselves.  Nothing here
+ever deletes ``.jax_cache`` entries; stale entries are merely
+recompiled under their new keys.
+
+Where the running jax supports ``jax.export``, each warmed program is
+additionally serialized to ``<cache>/export/<kernel>_b<bucket>.bin``
+(portable StableHLO, usable for cross-process AOT loading); failures
+are recorded, not fatal.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import cache as aot_cache
+
+MANIFEST_NAME = "warm_manifest.json"
+SCHEMA = 2
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+# sources whose edits can change a compiled kernel (or what gets warmed)
+SOURCE_DIRS = (
+    "lodestar_tpu/ops/bls12_381",
+    "lodestar_tpu/crypto/bls",
+    "lodestar_tpu/aot",
+)
+
+
+def source_fingerprint() -> str:
+    """sha256 over the kernel-relevant source tree (path + content)."""
+    h = hashlib.sha256()
+    for d in SOURCE_DIRS:
+        root = os.path.join(_REPO_ROOT, d)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(x for x in dirnames if x != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), _REPO_ROOT)
+                h.update(rel.encode())
+                with open(os.path.join(dirpath, fn), "rb") as fh:
+                    h.update(hashlib.sha256(fh.read()).digest())
+    return h.hexdigest()
+
+
+def environment_key() -> Dict[str, str]:
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "source": source_fingerprint(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def manifest_path(cache_dir: Optional[str] = None) -> str:
+    return os.path.join(cache_dir or aot_cache.repo_cache_dir(), MANIFEST_NAME)
+
+
+def load_manifest(cache_dir: Optional[str] = None) -> Dict:
+    path = manifest_path(cache_dir)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        data = {}
+    if data.get("schema") != SCHEMA:
+        data = {"schema": SCHEMA, "entries": {}}
+    data.setdefault("entries", {})
+    return data
+
+
+def save_manifest(manifest: Dict, cache_dir: Optional[str] = None) -> None:
+    """Atomic write (tmp + rename): a killed warm run must never leave
+    a half-written manifest that voids earlier banked programs."""
+    path = manifest_path(cache_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def program_state(
+    prog, manifest: Dict, cache_dir: str, envk: Dict[str, str]
+) -> str:
+    """"warm" | "stale" | "missing" for one registered program."""
+    entry = manifest.get("entries", {}).get(prog.key)
+    if entry is None:
+        return "missing"
+    for k in ("backend", "jax", "source"):
+        if entry.get(k) != envk[k]:
+            return "stale"
+    keys = entry.get("cache_keys") or []
+    # entries warmed before the spy captured a key are trusted on
+    # manifest freshness alone; captured keys are verified on disk
+    if keys and not all(aot_cache.entry_exists(cache_dir, k) for k in keys):
+        return "missing"
+    return "warm"
+
+
+# ---------------------------------------------------------------------------
+# warming
+# ---------------------------------------------------------------------------
+
+
+def _try_export(prog, cache_dir: str) -> Tuple[Optional[str], Optional[str]]:
+    """Serialize via jax.export where supported; (path, error)."""
+    try:
+        from jax import export as jexport
+    except ImportError:  # old jax: no export API
+        return None, "jax.export unavailable"
+    try:
+        exported = jexport.export(prog.fn())(*prog.example_args())
+        blob = exported.serialize()
+        out_dir = os.path.join(cache_dir, "export")
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, f"{prog.kernel}_b{prog.bucket}.bin")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+        os.replace(tmp, path)
+        return path, None
+    except Exception as e:  # serialization is best-effort by design
+        return None, f"{type(e).__name__}: {e}"
+
+
+def warm_program(prog, cache_dir: str, do_export: bool = True) -> Dict:
+    """Lower + compile ONE program (hitting the persistent cache when
+    the entry already exists) and return its manifest entry."""
+    aot_cache.install_cache_spy()
+    before = set(aot_cache.observed_keys())
+    t0 = time.monotonic()
+    lowered = prog.fn().lower(*prog.example_args())
+    lower_s = time.monotonic() - t0
+    t1 = time.monotonic()
+    lowered.compile()
+    compile_s = time.monotonic() - t1
+    prefix = f"jit_{prog.fn_name()}-"
+    events = {
+        k: kind
+        for k, kind in aot_cache.observed_keys().items()
+        if k not in before and k.startswith(prefix)
+    }
+    hit = any(kind == "hit" for kind in events.values())
+    entry = {
+        "kernel": prog.kernel,
+        "bucket": prog.bucket,
+        "cache_keys": sorted(events),
+        "cache_hit": hit,
+        "lower_s": round(lower_s, 3),
+        "compile_s": round(compile_s, 3),
+        "warmed_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if do_export:
+        path, err = _try_export(prog, cache_dir)
+        if path:
+            entry["export"] = os.path.basename(path)
+        if err:
+            entry["export_error"] = err
+    return entry
+
+
+def warm_programs(
+    programs: Sequence,
+    cache_dir: Optional[str] = None,
+    *,
+    budget_s: Optional[float] = None,
+    min_compile_time_secs: float = aot_cache.DEFAULT_MIN_COMPILE_SECS,
+    do_export: bool = True,
+    log=print,
+) -> Dict:
+    """Warm every program not already fresh, in priority order, saving
+    the manifest after EACH program.  ``budget_s`` stops before
+    STARTING a program that no longer fits (a started compile runs to
+    completion — killing it would bank nothing); the FIRST pending
+    program always starts, so even an undersized budget makes forward
+    progress across repeated invocations."""
+    cache_dir = aot_cache.configure(
+        cache_dir, min_compile_time_secs=min_compile_time_secs
+    )
+    envk = environment_key()
+    manifest = load_manifest(cache_dir)
+    t0 = time.monotonic()
+    report = {"compiled": [], "skipped": [], "deferred": [], "cache_dir": cache_dir}
+    for prog in programs:
+        state = program_state(prog, manifest, cache_dir, envk)
+        if state == "warm":
+            report["skipped"].append(prog.key)
+            log(f"aot warm: {prog.key} already warm — skipped")
+            continue
+        if (
+            budget_s is not None
+            and report["compiled"]
+            and time.monotonic() - t0 > budget_s
+        ):
+            report["deferred"].append(prog.key)
+            continue
+        log(f"aot warm: compiling {prog.key} ({state}) ...")
+        entry = warm_program(prog, cache_dir, do_export=do_export)
+        entry.update(envk)
+        manifest["entries"][prog.key] = entry
+        save_manifest(manifest, cache_dir)  # bank immediately
+        report["compiled"].append(prog.key)
+        log(
+            f"aot warm: {prog.key} done in {entry['compile_s']:.1f}s compile "
+            f"(+{entry['lower_s']:.1f}s lower, persistent-cache "
+            f"{'HIT' if entry['cache_hit'] else 'miss'})"
+        )
+    if report["deferred"]:
+        log(
+            "aot warm: budget exhausted — deferred "
+            + ", ".join(report["deferred"])
+            + " (re-run to continue; finished programs are banked)"
+        )
+    return report
+
+
+def check_programs(
+    programs: Sequence, cache_dir: Optional[str] = None
+) -> Tuple[bool, List[Tuple[str, str]]]:
+    """(all_warm, [(program key, state)]).  Read-only: no compiles, no
+    lowering — manifest freshness + on-disk cache entries only."""
+    cache_dir = cache_dir or aot_cache.repo_cache_dir()
+    envk = environment_key()
+    manifest = load_manifest(cache_dir)
+    rows = [
+        (p.key, program_state(p, manifest, cache_dir, envk)) for p in programs
+    ]
+    return all(state == "warm" for _, state in rows), rows

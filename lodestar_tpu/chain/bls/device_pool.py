@@ -15,11 +15,13 @@ amortizing dispatch + padded-bucket compile reuse (16/32/64/128).
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_set
+from lodestar_tpu.ops.bls12_381 import buckets as bk
 from lodestar_tpu.utils import gather_settled
 from .interface import VerifyOptions
 from .metrics import BlsPoolMetrics
@@ -52,6 +54,23 @@ MODEL_PER_SET_S = 0.00017
 MIN_JOB_WIDTH = 128
 
 
+def governed_steady_width(max_sets_per_job: int = MAX_SIGNATURE_SETS_PER_JOB) -> int:
+    """Steady-state governed job width, aligned UP to the pool's
+    compile rung: the raw model width (e.g. 882) already pads to the
+    1024-bucket program at dispatch, so jobs up to the full rung cost
+    the device EXACTLY the same padded program while serving more sets
+    — aligning down instead would cut steady throughput ~30% for no
+    latency gain.  ops/bls12_381/buckets.py is the shared source of the
+    rung geometry and the AOT warm registry compiles exactly these, so
+    the governor can never mint a program shape the warm tool does not
+    know about."""
+    budget_width = int((LATENCY_BUDGET_S / 2 - MODEL_FLOOR_S) / MODEL_PER_SET_S)
+    raw = min(max_sets_per_job, max(MIN_JOB_WIDTH, budget_width))
+    # pool_bucket respects a tiny explicit cap (tests build 1-8 set
+    # pools, which fall back to the direct ladder) via min() below
+    return min(max_sets_per_job, bk.pool_bucket(raw, cap=max_sets_per_job))
+
+
 @dataclass
 class _BufferedJob:
     sets: List[SignatureSet]
@@ -69,7 +88,15 @@ class DeviceBlsVerifier:
         max_sets_per_job: int = MAX_SIGNATURE_SETS_PER_JOB,
     ):
         # _backend injection point for tests (defaults to the jit kernels)
+        is_production_backend = _backend is None
         if _backend is None:
+            # production node path: enable the persistent compilation
+            # cache BEFORE the first kernel dispatch — previously the
+            # node never configured it and paid a full cold compile
+            # every process start (ISSUE 5)
+            from lodestar_tpu.aot import cache as aot_cache
+
+            aot_cache.configure()
             from lodestar_tpu.ops.bls12_381 import verify as dv
 
             _backend = dv
@@ -78,13 +105,23 @@ class DeviceBlsVerifier:
         self._buffer: List[_BufferedJob] = []
         self._buffer_sigs = 0
         self._flush_handle: Optional[asyncio.TimerHandle] = None
-        self._inflight = False
+        # pipeline stage flag: a pack owns the host ENCODE stage from
+        # dispatch until it acquires the device; the device itself is
+        # serialized by _device_lock, so encode of pack N+1 overlaps
+        # device execution of pack N
+        self._encoding = False
         self._device_lock = asyncio.Lock()
         self._metrics = metrics
         self._closed = False
         # strong refs: the event loop only weakly references tasks, and a
         # GC'd job task would strand its waiters forever
         self._tasks: set = set()
+        self._cache_spy_cb = None
+        # only the production jit backend compiles programs: wiring the
+        # spy + warm-manifest check for a fake test backend would drag
+        # jax (backend init, source-tree hashing) into tests for nothing
+        if metrics is not None and is_production_backend:
+            self._wire_compile_observability(metrics)
 
     # ------------------------------------------------------------------
 
@@ -128,6 +165,11 @@ class DeviceBlsVerifier:
         return all(results)
 
     async def close(self) -> None:
+        """Cancel-and-settle: buffered requests are failed immediately,
+        in-flight job tasks are cancelled and AWAITED so close cannot
+        strand a running device job's waiters or leave its executor
+        call unobserved (_run_pack settles its pack's futures on
+        cancellation before re-raising)."""
         self._closed = True
         if self._flush_handle:
             self._flush_handle.cancel()
@@ -137,6 +179,69 @@ class DeviceBlsVerifier:
                 job.future.set_exception(RuntimeError("verifier closed"))
         self._buffer.clear()
         self._buffer_sigs = 0
+        tasks = [t for t in self._tasks if not t.done()]
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            # settle every cancelled task; exceptions (incl. the
+            # CancelledErrors we just caused) are retrieved here, not
+            # left to the loop's unhandled-exception logger
+            await asyncio.gather(*tasks, return_exceptions=True)
+        if self._cache_spy_cb is not None:
+            # release the process-global spy's strong ref to this pool
+            # (a restarted node would otherwise multiply-count every
+            # cache event into the shared metrics singleton)
+            from lodestar_tpu.aot import cache as aot_cache
+
+            aot_cache.remove_cache_spy_callback(self._cache_spy_cb)
+            self._cache_spy_cb = None
+
+    def _wire_compile_observability(self, metrics: BlsPoolMetrics) -> None:
+        """Feed persistent-cache hit/miss + compile-time events into the
+        Prometheus family and publish warm-manifest freshness (tentpole
+        observability: a node operator can SEE whether first-verify will
+        compile cold).  Best-effort: a fake backend without jax present
+        must not break pool construction."""
+        try:
+            from lodestar_tpu.aot import cache as aot_cache
+
+            aot_cache.install_cache_spy(self._on_cache_event)
+            self._cache_spy_cb = self._on_cache_event
+        except Exception:
+            return
+
+        def _freshness() -> None:
+            # backend init + a source-tree fingerprint walk cost
+            # seconds: off the constructing thread (typically the event
+            # loop during node startup).  prometheus gauges are
+            # thread-safe; the values land moments after construction.
+            try:
+                from lodestar_tpu.aot import registry, warm
+
+                ok, rows = warm.check_programs(registry.registered_programs())
+                metrics.warm_manifest_fresh.set(1 if ok else 0)
+                metrics.warm_programs_total.set(len(rows))
+                metrics.warm_programs_warm.set(
+                    sum(1 for _, s in rows if s == "warm")
+                )
+            except Exception:
+                # no jax / no manifest yet: freshness is unknown-cold
+                metrics.warm_manifest_fresh.set(0)
+
+        threading.Thread(
+            target=_freshness, name="bls-warm-freshness", daemon=True
+        ).start()
+
+    def _on_cache_event(self, kind: str, cache_key: str, seconds: float) -> None:
+        m = self._metrics
+        if m is None:
+            return
+        if kind == "hit":
+            m.persistent_cache_hits.inc()
+        elif kind == "miss":
+            m.persistent_cache_misses.inc()
+        elif kind == "put":
+            m.compile_time.observe(seconds)
 
     # ------------------------------------------------------------------
 
@@ -161,28 +266,29 @@ class DeviceBlsVerifier:
 
     def _steady_width_cap(self) -> int:
         """Width where t(width) <= LATENCY_BUDGET_S/2 under the fitted
-        latency model (worst case = in-flight job + own job)."""
-        budget_width = int(
-            (LATENCY_BUDGET_S / 2 - MODEL_FLOOR_S) / MODEL_PER_SET_S
-        )
-        # MIN_JOB_WIDTH floors the MODEL-derived width (a degenerate fit
-        # must not trickle tiny jobs) but never overrides an explicitly
-        # smaller pool cap (tests construct 8-set pools)
-        return min(self._max_sets_per_job, max(MIN_JOB_WIDTH, budget_width))
+        latency model (worst case = in-flight job + own job), aligned
+        UP to the pool compile rung the raw width would pad into anyway
+        so the governor can only produce program shapes the AOT warm
+        registry compiled.  MIN_JOB_WIDTH
+        floors the model-derived width (a degenerate fit must not
+        trickle tiny jobs) but never overrides an explicitly smaller
+        pool cap (tests construct 8-set pools)."""
+        return governed_steady_width(self._max_sets_per_job)
 
     def _latency_width_cap(self) -> int:
         """Steady-state governed width — unless the backlog already
         exceeds what capped jobs can clear in-budget, which is overload:
-        revert to max-width drain (throughput-optimal).  The threshold
-        is at least one full max job so a single wide request's chunks
-        (just gathered by verify_signature_sets) cannot flip the pool
-        into overload and re-fuse themselves into one over-budget job."""
+        revert to max-width drain (throughput-optimal, bucket-aligned).
+        The threshold is at least one full max job so a single wide
+        request's chunks (just gathered by verify_signature_sets) cannot
+        flip the pool into overload and re-fuse themselves into one
+        over-budget job."""
         cap = self._steady_width_cap()
         # threshold: a full max-size request's chunks PLUS a capped job's
         # worth of bystanders must not count as overload (else the just-
         # chunked request re-fuses into one over-budget job)
         if self._buffer_sigs > self._max_sets_per_job + cap:
-            return self._max_sets_per_job
+            return bk.align_down(self._max_sets_per_job)
         return cap
 
     def _schedule_flush(self, delay: float) -> None:
@@ -194,13 +300,25 @@ class DeviceBlsVerifier:
     def _flush(self) -> None:
         """Work-conserving dispatch: take ONE pack (the whole backlog,
         up to the job cap) and run it; remaining requests stay buffered
-        and become the next job the moment the device frees.  Under
-        load the job width adapts to arrival_rate x job_time instead of
-        trickling fixed-size jobs through the window."""
+        and become the next job the moment the ENCODE stage frees (not
+        the device: pack N+1 encodes on the host executor while pack N
+        holds the device lock).  Under load the job width adapts to
+        arrival_rate x stage_time instead of trickling fixed-size jobs
+        through the window."""
         self._flush_handle = None
-        if not self._buffer or self._inflight:
+        if self._closed or not self._buffer or self._encoding:
             return
         width_cap = self._latency_width_cap()
+        if self._device_lock.locked() and self._buffer_sigs < width_cap:
+            # The device is busy and the backlog can't fill a full-width
+            # pack: forming a partial pack EARLY would pay an extra
+            # kernel floor and deepen worst-case queueing for zero
+            # throughput gain — only full-width packs are worth encoding
+            # ahead of the device.  Re-arm the window; the running
+            # pack's completion (or the backlog reaching full width)
+            # re-triggers us sooner.
+            self._schedule_flush(MAX_BUFFER_WAIT_MS / 1000)
+            return
         pack: List[_BufferedJob] = []
         count = 0
         while self._buffer:
@@ -212,27 +330,59 @@ class DeviceBlsVerifier:
         self._buffer_sigs -= count
         if self._metrics:
             self._metrics.job_queue_length.set(self._buffer_sigs)
-        self._inflight = True
+        self._encoding = True
         task = asyncio.ensure_future(self._run_pack(pack))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
+    def _release_encode(self) -> None:
+        """Free the encode stage and wake the next pack.  Callers track
+        ownership (a pack releases exactly once — the moment it
+        transitions encode -> device, or from _run_pack's finally if it
+        failed before reaching the lock)."""
+        self._encoding = False
+        if self._buffer and not self._closed:
+            self._schedule_flush(0)
+
     async def _run_pack(self, pack: List[_BufferedJob]) -> None:
+        # ownership token for the encode stage: _run_job clears it when
+        # the pack reaches the device; if we still hold it in finally,
+        # the pack died during encode and must free the stage itself
+        owns = {"encode": True}
         try:
-            await self._run_job(pack)
+            await self._run_job(pack, encode_owner=owns)
+        except asyncio.CancelledError:
+            # close() cancel-and-settle: fail the pack's waiters, then
+            # let the cancellation propagate to the gather in close()
+            for job in pack:
+                if not job.future.done():
+                    job.future.set_exception(RuntimeError("verifier closed"))
+            raise
         except Exception as e:  # propagate to waiters
             for job in pack:
                 if not job.future.done():
                     job.future.set_exception(e)
         finally:
-            self._inflight = False
+            if owns["encode"]:
+                owns["encode"] = False
+                self._release_encode()
             if self._buffer and not self._closed:
                 self._schedule_flush(0)
 
-    async def _run_job(self, pack: List[_BufferedJob]) -> bool:
+    async def _run_job(
+        self, pack: List[_BufferedJob], encode_owner: Optional[dict] = None
+    ) -> bool:
         """Run one device job for a pack of requests; resolves each
         request's future.  Returns the AND of all results (for the
-        immediate-dispatch path)."""
+        immediate-dispatch path).
+
+        Two pipeline stages: host ENCODE (expand_message_xmd, field-draw
+        reduction, limb packing) runs on the executor BEFORE taking the
+        device lock; the encode stage is released the moment the device
+        lock is acquired, so the next pack's encode overlaps this one's
+        device execution while at most one encoded pack waits at the
+        lock (bounded pipeline depth, keeps the governor's worst-case
+        latency model honest)."""
         all_sets: List[SignatureSet] = []
         for job in pack:
             all_sets.extend(job.sets)
@@ -245,9 +395,21 @@ class DeviceBlsVerifier:
 
         loop = asyncio.get_running_loop()
         t0 = time.monotonic()
+        bucket = bk.pool_bucket(len(all_sets), cap=self._max_sets_per_job)
+        encoded = await loop.run_in_executor(
+            None, lambda: self._dv.encode_job(all_sets, bucket=bucket)
+        )
+        if self._metrics:
+            self._metrics.encode_time.observe(time.monotonic() - t0)
         async with self._device_lock:
+            # we own the device: free the encode stage for pack N+1
+            # (only the buffered-flush path owns the encode stage — an
+            # immediate-dispatch job must not release someone else's)
+            if encode_owner is not None and encode_owner["encode"]:
+                encode_owner["encode"] = False
+                self._release_encode()
             batch_ok = await loop.run_in_executor(
-                None, self._dv.verify_signature_sets_device, all_sets
+                None, self._dv.execute_batch, encoded
             )
             if batch_ok:
                 per_set: Optional[List[bool]] = None
@@ -256,8 +418,16 @@ class DeviceBlsVerifier:
                 if self._metrics:
                     self._metrics.batch_retries.inc()
                 per_set = await loop.run_in_executor(
-                    None, self._dv.verify_each_device, all_sets
+                    None, lambda: self._dv.verify_each_device(all_sets, bucket=bucket)
                 )
+        # device released: wake any deferred partial pack NOW.  The
+        # buffered path also schedules from _run_pack's finally, but the
+        # immediate-dispatch path reaches the lock only through here —
+        # without this, back-to-back immediate jobs would keep the lock
+        # busy while _flush re-arms its window forever, starving
+        # buffered sub-cap requests past the latency budget.
+        if self._buffer and not self._closed:
+            self._schedule_flush(0)
         if self._metrics:
             self._metrics.job_run_time.observe(time.monotonic() - t0)
 

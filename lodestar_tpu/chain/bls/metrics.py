@@ -43,6 +43,50 @@ class BlsPoolMetrics:
             buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 2, 5),
             registry=registry,
         )
+        self.encode_time = Histogram(
+            f"{ns}_encode_time_seconds",
+            "Host encode stage wall time per job (expand_message_xmd + "
+            "field-draw reduction + limb packing; overlaps device "
+            "execution of the previous job)",
+            buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1),
+            registry=registry,
+        )
+        # AOT compile-lifecycle observability (lodestar_tpu/aot): XLA
+        # compile times and persistent-cache traffic seen by THIS
+        # process, plus warm-manifest freshness at pool construction —
+        # a cold first-verify is visible before it costs a slot.
+        self.compile_time = Histogram(
+            f"{ns}_xla_compile_seconds",
+            "XLA compile wall time per program (persistent-cache misses)",
+            buckets=(1, 5, 15, 60, 300, 900, 1800, 3600),
+            registry=registry,
+        )
+        self.persistent_cache_hits = Counter(
+            f"{ns}_persistent_cache_hits_total",
+            "Compiled programs loaded from the persistent cache",
+            registry=registry,
+        )
+        self.persistent_cache_misses = Counter(
+            f"{ns}_persistent_cache_misses_total",
+            "Programs the persistent cache did not hold (cold compile)",
+            registry=registry,
+        )
+        self.warm_manifest_fresh = Gauge(
+            f"{ns}_warm_manifest_fresh",
+            "1 if every AOT-registered program was warm at pool start "
+            "(manifest fresh for this backend/jax/source)",
+            registry=registry,
+        )
+        self.warm_programs_total = Gauge(
+            f"{ns}_warm_programs_registered",
+            "AOT-registered programs for this node's dispatch set",
+            registry=registry,
+        )
+        self.warm_programs_warm = Gauge(
+            f"{ns}_warm_programs_warm",
+            "AOT-registered programs present + fresh at pool start",
+            registry=registry,
+        )
 
     @classmethod
     def get(cls) -> "BlsPoolMetrics":

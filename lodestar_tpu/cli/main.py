@@ -121,6 +121,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp_imp.add_argument("--file", type=str, required=True)
     sp_imp.add_argument("--genesis-validators-root", type=str, required=True)
 
+    aot = sub.add_parser(
+        "aot",
+        help="AOT compile-cache tooling: warm/check the persistent BLS "
+        "program cache (same as python -m lodestar_tpu.aot)",
+    )
+    aot.add_argument(
+        "aot_args",
+        nargs=argparse.REMAINDER,
+        help="arguments for the aot tool, e.g. `warm`, `warm --check`",
+    )
+
     flare = sub.add_parser(
         "flare", help="ops/debug tooling: craft self-slashings for OWNED devnet keys"
     )
@@ -796,6 +807,10 @@ def main(argv=None) -> int:
         return run_slashing_protection(args, export=False)
     if args.command == "flare":
         return run_flare(args)
+    if args.command == "aot":
+        from lodestar_tpu.aot.__main__ import main as aot_main
+
+        return aot_main(args.aot_args)
     parser.print_help()
     return 1
 

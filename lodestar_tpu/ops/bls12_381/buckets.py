@@ -1,0 +1,69 @@
+"""Compile-bucket geometry for the BLS kernels — pure integer math.
+
+Every jitted BLS program has a static batch width, so dynamic batch
+sizes are met by padding up to a small set of compile buckets.  This
+module is the ONE place that set is defined; the kernel wrappers
+(ops/bls12_381/verify.py), the device pool's latency governor
+(chain/bls/device_pool.py) and the AOT warm registry
+(lodestar_tpu/aot/registry.py) all derive their widths from it, so the
+governor can never mint a program shape the warm tool does not know
+about.
+
+Deliberately jax-free: the device pool imports it for width policy in
+service tests that never touch a kernel.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+# Direct-call buckets (verify_signature_sets_device etc.): geometric up
+# to 512, then 512-granular — the Pallas kernels keep per-batch latency
+# nearly flat up to ~512 sets, so large buckets pay off.
+BUCKETS: Tuple[int, ...] = (4, 8, 16, 32, 64, 128, 256, 512)
+_STEP = 512
+
+# The device pool quantizes every job to one of THESE widths (not the
+# full direct-call ladder): the kernel's latency is floor-dominated, so
+# padding a 3-set job to 128 costs almost nothing on device while
+# collapsing the set of programs the warm tool must compile from eleven
+# buckets to four — trickle (128), governed steady state (512), the
+# mid drain rung (1024) and the overload drain (2048).
+POOL_BUCKETS: Tuple[int, ...] = (128, 512, 1024, 2048)
+
+
+def bucket_size(n: int) -> int:
+    """Smallest compile bucket holding n sets (512-granular beyond 512)."""
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    return ((n + _STEP - 1) // _STEP) * _STEP
+
+
+def pool_bucket(n: int, cap: Optional[int] = None) -> int:
+    """The pool's dispatch bucket for an n-set job: the smallest
+    POOL_BUCKETS width holding it (respecting an explicit pool cap —
+    tests build 8-set pools, which fall back to the direct ladder).
+    When no rung or ladder bucket fits under the cap (a non-rung cap
+    like 600 with n near it), the cap itself is the width: the job must
+    still be held, and padding past an explicit cap is never allowed."""
+    for b in POOL_BUCKETS:
+        if n <= b and (cap is None or b <= cap):
+            return b
+    b = bucket_size(n)
+    if cap is not None and b > cap >= n:
+        return cap
+    return b
+
+
+def align_down(n: int) -> int:
+    """Largest bucket-boundary width <= n (floor; never below the
+    smallest bucket).  The latency governor aligns its width caps with
+    this so a cap like 882 dispatches 512-bucket jobs instead of
+    minting an unwarmed 1024-bucket program at runtime."""
+    if n >= _STEP:
+        return (n // _STEP) * _STEP
+    best = BUCKETS[0]
+    for b in BUCKETS:
+        if b <= n:
+            best = b
+    return best

@@ -351,22 +351,24 @@ def verify_each(pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active):
 # host-side wrappers: oracle objects -> device tensors, jit cache per bucket
 # ---------------------------------------------------------------------------
 
-_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512)
+from .buckets import BUCKETS as _BUCKETS, bucket_size  # noqa: F401,E402
 
+# The jit wrappers live in the AOT registry (lodestar_tpu/aot/registry.py)
+# — the single source of truth for every program the warm tool must
+# compile.  The module attributes below are THE registry objects, kept
+# under their historical names for call sites (bench.py, tests).
+from lodestar_tpu.aot import registry as _aot_registry  # noqa: E402
 
-def bucket_size(n: int) -> int:
-    """Smallest compile bucket holding n sets (ceil to the largest bucket
-    granularity beyond; large buckets pay off now that the Pallas kernels
-    keep per-batch latency nearly flat up to ~512 sets)."""
-    for b in _BUCKETS:
-        if n <= b:
-            return b
-    return ((n + 511) // 512) * 512
+_aot_registry.register_kernels(
+    batch=verify_signature_sets,
+    hashed=verify_signature_sets_hashed,
+    each=verify_each,
+    fast_agg=fast_aggregate_verify,
+)
 
-
-_jit_batch = jax.jit(verify_signature_sets)
-_jit_hashed = jax.jit(verify_signature_sets_hashed)
-_jit_each = jax.jit(verify_each)
+_jit_batch = _aot_registry.jitted("batch")
+_jit_hashed = _aot_registry.jitted("hashed")
+_jit_each = _aot_registry.jitted("each")
 
 
 def _encode_pk_sig(sets, size: int):
@@ -413,22 +415,43 @@ def use_device_h2c() -> bool:
     return fp._target_platform() == "tpu"
 
 
-def verify_signature_sets_device(sets, rand=None) -> bool:
-    """Host entry: batch-verify oracle SignatureSets on the device.
+class EncodedJob:
+    """Host-encoded device job: padded tensors + dispatch metadata.
 
-    Mirrors oracle api.verify_multiple_signature_sets: False on empty input,
-    False if any pubkey/signature is infinity or the signature fails the
-    subgroup check (checked host-side on deserialization).  On TPU the
-    messages are hashed to curve ON DEVICE (verify_signature_sets_hashed);
-    the host only runs expand_message_xmd + field reduction."""
+    Produced by ``encode_job`` (host CPU work only: expand_message_xmd,
+    field-draw reduction, limb packing), consumed by ``execute_batch``
+    (device dispatch + sync).  The split lets the pool encode job N+1
+    on its host executor while job N holds the device — see
+    chain/bls/device_pool.py.
+    """
+
+    __slots__ = ("kind", "n", "bucket", "args")
+
+    def __init__(self, kind: str, n: int, bucket: int, args):
+        self.kind = kind  # "hashed" | "batch" | "reject"
+        self.n = n
+        self.bucket = bucket
+        self.args = args
+
+
+def encode_job(sets, rand=None, bucket=None) -> EncodedJob:
+    """Host encode stage: oracle SignatureSets -> device-ready tensors.
+
+    Performs the host-side rejection checks (empty input, infinity
+    pubkey/signature) up front — a rejected job carries kind="reject"
+    and execute_batch returns False without touching the device.
+    ``bucket`` overrides the padded width (the pool passes its
+    quantized dispatch bucket so job shapes stay inside the AOT warm
+    registry); it must be >= len(sets)."""
     import os as _os
 
     if not sets:
-        return False
+        return EncodedJob("reject", 0, 0, None)
     for s in sets:
         if s.public_key.point is None or s.signature.point is None:
-            return False
-    size = bucket_size(len(sets))
+            return EncodedJob("reject", len(sets), 0, None)
+    size = bucket if bucket is not None else bucket_size(len(sets))
+    assert size >= len(sets), f"bucket {size} < {len(sets)} sets"
     if rand is None:
         rand = [int.from_bytes(_os.urandom(8), "big") | 1 for _ in sets]
     rand = list(rand) + [1] * (size - len(rand))
@@ -438,18 +461,47 @@ def verify_signature_sets_device(sets, rand=None) -> bool:
 
         pk_aff, pk_inf, sig_aff, sig_inf, active = _encode_pk_sig(sets, size)
         u0, u1 = _h2c.encode_field_draws([s.message for s in sets], size)
-        return bool(  # lodelint: disable=host-sync — API boundary: callers need a python bool
-            _jit_hashed(pk_aff, pk_inf, u0, u1, sig_aff, sig_inf, bits, active)
+        return EncodedJob(
+            "hashed",
+            len(sets),
+            size,
+            (pk_aff, pk_inf, u0, u1, sig_aff, sig_inf, bits, active),
         )
     pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active = _encode_sets(
         sets, size
     )
-    return bool(  # lodelint: disable=host-sync — API boundary: callers need a python bool
-        _jit_batch(pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, bits, active)
+    return EncodedJob(
+        "batch",
+        len(sets),
+        size,
+        (pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, bits, active),
     )
 
 
-_jit_fast_agg = jax.jit(fast_aggregate_verify)
+def execute_batch(job: EncodedJob) -> bool:
+    """Device execute stage for an encoded job: dispatch + sync."""
+    if job.kind == "reject":
+        return False
+    fn = _jit_hashed if job.kind == "hashed" else _jit_batch
+    return bool(  # lodelint: disable=host-sync — API boundary: callers need a python bool
+        fn(*job.args)
+    )
+
+
+def verify_signature_sets_device(sets, rand=None) -> bool:
+    """Host entry: batch-verify oracle SignatureSets on the device.
+
+    Mirrors oracle api.verify_multiple_signature_sets: False on empty input,
+    False if any pubkey/signature is infinity or the signature fails the
+    subgroup check (checked host-side on deserialization).  On TPU the
+    messages are hashed to curve ON DEVICE (verify_signature_sets_hashed);
+    the host only runs expand_message_xmd + field reduction.  This is
+    encode_job + execute_batch in one call; the pool runs the two stages
+    pipelined instead."""
+    return execute_batch(encode_job(sets, rand=rand))
+
+
+_jit_fast_agg = _aot_registry.jitted("fast_agg")
 
 
 def fast_aggregate_verify_device(public_keys, message: bytes, signature) -> bool:
@@ -484,11 +536,15 @@ def fast_aggregate_verify_device(public_keys, message: bytes, signature) -> bool
     )
 
 
-def verify_each_device(sets):
-    """Host entry: per-set verification, returns list[bool]."""
+def verify_each_device(sets, bucket=None):
+    """Host entry: per-set verification, returns list[bool].  ``bucket``
+    overrides the padded width (the pool passes the same quantized
+    bucket as the failed batch job, so the fallback stays inside the
+    warm registry's program set)."""
     if not sets:
         return []
-    size = bucket_size(len(sets))
+    size = bucket if bucket is not None else bucket_size(len(sets))
+    assert size >= len(sets), f"bucket {size} < {len(sets)} sets"
     pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, act = _encode_sets(sets, size)
     out = _jit_each(pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, act)
     # API boundary: the per-set host bools leave the device here

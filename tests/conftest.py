@@ -46,8 +46,11 @@ except Exception:
 # overrides the env var) — force it back to cpu
 jax.config.update("jax_platforms", "cpu")
 
-jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# ONE cache-config path for every entry point (ISSUE 5): node, bench,
+# tests, __graft_entry__ and diagnose_cache all call aot.cache.configure
+from lodestar_tpu.aot import cache as _aot_cache  # noqa: E402
+
+_aot_cache.configure()
 
 
 # ---------------------------------------------------------------------------
@@ -111,6 +114,7 @@ _SLOW_FILES = {
 # no longer slip into tier-1 by simply not being listed anywhere.
 _FAST_FILES = {
     "test_altair.py",
+    "test_aot.py",
     "test_dashboards.py",
     "test_db.py",
     "test_eth1.py",

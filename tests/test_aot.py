@@ -1,0 +1,363 @@
+"""AOT compile-lifecycle subsystem tests (ISSUE 5).
+
+Covers the registry enumeration, the resumable warmer + freshness
+manifest (staleness on source-hash change, per-program banking under a
+budget), and the cache configure/spy plumbing — all with throwaway
+TINY jit programs in tmp cache dirs, so nothing here compiles a
+pairing kernel or touches the repo's real .jax_cache.
+"""
+import json
+import os
+
+import pytest
+
+from lodestar_tpu.aot import cache as aot_cache
+from lodestar_tpu.aot import registry, warm
+from lodestar_tpu.ops.bls12_381 import buckets as bk
+
+
+@pytest.fixture
+def tmp_cache(tmp_path):
+    """Point jax's persistent cache at a tmp dir; ALWAYS restore the
+    repo cache afterwards (other test files rely on it)."""
+    d = str(tmp_path / "cache")
+    prev = aot_cache.repo_cache_dir()
+    aot_cache.configure(d, min_compile_time_secs=0.0)
+    yield d
+    aot_cache.configure(prev)
+
+
+class TinyProg:
+    """warm.py duck-type of registry.Program with a millisecond-compile
+    function (shape varies by bucket so each bucket is a new program)."""
+
+    def __init__(self, kernel="tiny", bucket=4, salt=1.0):
+        self.kernel = kernel
+        self.bucket = bucket
+        self.salt = salt
+
+    @property
+    def key(self):
+        return f"{self.kernel}/b{self.bucket}"
+
+    def fn(self):
+        import jax
+
+        salt = self.salt
+
+        def tiny_kernel(x):
+            return (x * salt).sum()
+
+        return jax.jit(tiny_kernel)
+
+    def fn_name(self):
+        return "tiny_kernel"
+
+    def example_args(self):
+        import jax.numpy as jnp
+
+        return (jnp.zeros((self.bucket,), jnp.float32),)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_core_covers_bench_and_pool(self):
+        from lodestar_tpu.chain.bls import device_pool as dp
+
+        keys = registry.registered_keys(device_h2c=False)
+        # bench stages (device-h2c kernel, both stage widths)
+        for b in registry.bench_buckets():
+            assert f"hashed/b{b}" in keys
+        # every pool dispatch rung up to the overload drain width
+        drain = bk.align_down(dp.MAX_SIGNATURE_SETS_PER_JOB)
+        for b in bk.POOL_BUCKETS:
+            if b <= drain:
+                assert f"batch/b{b}" in keys
+        # the governed steady width itself must be a registered rung
+        steady = dp.governed_steady_width()
+        assert f"batch/b{steady}" in keys
+
+    def test_full_scope_superset_includes_fallback(self):
+        core = set(registry.registered_keys(device_h2c=False))
+        full = set(registry.registered_keys("full", device_h2c=False))
+        assert core < full
+        assert any(k.startswith("each/") for k in full)
+        # dedupe: one entry per key even though scopes overlap
+        progs = registry.registered_programs("full", device_h2c=False)
+        assert len(progs) == len({p.key for p in progs})
+
+    def test_h2c_mode_selects_kernel(self):
+        tpu_keys = registry.registered_keys(device_h2c=True)
+        assert any(k.startswith("hashed/") for k in tpu_keys)
+        assert not any(k.startswith("batch/") for k in tpu_keys)
+
+    def test_jitted_is_memoized_shared_wrapper(self):
+        from lodestar_tpu.ops.bls12_381 import verify as dv
+
+        assert registry.jitted("batch") is registry.jitted("batch")
+        # verify.py's historical module attributes ARE the registry objects
+        assert dv._jit_batch is registry.jitted("batch")
+        assert dv._jit_hashed is registry.jitted("hashed")
+        with pytest.raises(KeyError):
+            registry.jitted("nope")
+
+    def test_jitted_before_verify_import_shares_wrapper(self):
+        """jitted() called BEFORE ops/bls12_381/verify.py is imported
+        must hand out the same wrapper verify.py's module attributes
+        got: ensure_kernels() triggers the verify import, whose module
+        body calls jitted() reentrantly — a second wrapper minted by
+        the outer frame would silently split the trace cache by import
+        order.  Needs a fresh process (this one already imported
+        verify)."""
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = (
+            "from lodestar_tpu.aot import registry\n"
+            "w = registry.jitted('batch')\n"
+            "import lodestar_tpu.ops.bls12_381.verify as dv\n"
+            "assert dv._jit_batch is registry.jitted('batch')\n"
+            "assert dv._jit_batch is w\n"
+        )
+        env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env=env,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    def test_bench_buckets_follow_env(self, monkeypatch):
+        monkeypatch.setenv("BENCH_BATCH_MAX", "512")
+        assert registry.bench_buckets() == [512]
+        monkeypatch.setenv("BENCH_BATCH_MAX", "4096")
+        assert registry.bench_buckets() == [1024, 4096]
+
+
+# ---------------------------------------------------------------------------
+# warm + manifest
+# ---------------------------------------------------------------------------
+
+
+class TestWarm:
+    def test_warm_then_check_roundtrip(self, tmp_cache):
+        progs = [TinyProg(bucket=4), TinyProg(bucket=8)]
+        report = warm.warm_programs(
+            progs, tmp_cache, min_compile_time_secs=0.0, do_export=False,
+            log=lambda m: None,
+        )
+        assert report["compiled"] == ["tiny/b4", "tiny/b8"]
+        ok, rows = warm.check_programs(progs, tmp_cache)
+        assert ok, rows
+        # second run skips everything (resumable no-op)
+        report2 = warm.warm_programs(
+            progs, tmp_cache, min_compile_time_secs=0.0, do_export=False,
+            log=lambda m: None,
+        )
+        assert report2["skipped"] == ["tiny/b4", "tiny/b8"]
+        assert not report2["compiled"]
+
+    def test_budget_banks_finished_programs(self, tmp_cache):
+        """A warm run stopped by the budget must bank every finished
+        program: the next invocation skips them and continues."""
+        progs = [TinyProg(bucket=4), TinyProg(bucket=8), TinyProg(bucket=16)]
+        report = warm.warm_programs(
+            progs, tmp_cache, budget_s=0.0, min_compile_time_secs=0.0,
+            do_export=False, log=lambda m: None,
+        )
+        # budget 0: the first program still runs (budget checks happen
+        # BEFORE starting a program), the rest defer
+        assert report["compiled"] == ["tiny/b4"]
+        assert report["deferred"] == ["tiny/b8", "tiny/b16"]
+        ok, rows = warm.check_programs(progs, tmp_cache)
+        assert not ok
+        assert dict(rows)["tiny/b4"] == "warm"
+        # resume: only the deferred programs compile
+        report2 = warm.warm_programs(
+            progs, tmp_cache, min_compile_time_secs=0.0, do_export=False,
+            log=lambda m: None,
+        )
+        assert report2["skipped"] == ["tiny/b4"]
+        assert report2["compiled"] == ["tiny/b8", "tiny/b16"]
+
+    def test_source_hash_change_goes_stale(self, tmp_cache, monkeypatch):
+        """ISSUE 5 satellite: editing a kernel-relevant source must fail
+        `warm --check` until re-warmed — never silently serve a manifest
+        stamped for different code."""
+        progs = [TinyProg(bucket=4)]
+        warm.warm_programs(
+            progs, tmp_cache, min_compile_time_secs=0.0, do_export=False,
+            log=lambda m: None,
+        )
+        ok, _ = warm.check_programs(progs, tmp_cache)
+        assert ok
+        monkeypatch.setattr(warm, "source_fingerprint", lambda: "deadbeef")
+        ok, rows = warm.check_programs(progs, tmp_cache)
+        assert not ok
+        assert dict(rows)["tiny/b4"] == "stale"
+        # re-warm under the new fingerprint re-stamps the manifest (the
+        # persistent cache itself is untouched, so this is a fast reload)
+        report = warm.warm_programs(
+            progs, tmp_cache, min_compile_time_secs=0.0, do_export=False,
+            log=lambda m: None,
+        )
+        assert report["compiled"] == ["tiny/b4"]
+        ok, _ = warm.check_programs(progs, tmp_cache)
+        assert ok
+
+    def test_missing_cache_entry_detected(self, tmp_cache):
+        """A manifest entry whose on-disk cache files were lost (pruned
+        LRU, copied tree) reports missing, not warm."""
+        progs = [TinyProg(bucket=4)]
+        warm.warm_programs(
+            progs, tmp_cache, min_compile_time_secs=0.0, do_export=False,
+            log=lambda m: None,
+        )
+        manifest = warm.load_manifest(tmp_cache)
+        keys = manifest["entries"]["tiny/b4"].get("cache_keys") or []
+        assert keys, "spy captured no cache keys for the warmed program"
+        for k in keys:
+            for suffix in ("", "-cache"):
+                p = os.path.join(tmp_cache, k + suffix)
+                if os.path.isfile(p):
+                    os.unlink(p)
+        ok, rows = warm.check_programs(progs, tmp_cache)
+        assert not ok
+        assert dict(rows)["tiny/b4"] == "missing"
+
+    def test_manifest_atomic_and_schema_guard(self, tmp_cache):
+        path = warm.manifest_path(tmp_cache)
+        os.makedirs(tmp_cache, exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write("{ truncated garbage")
+        assert warm.load_manifest(tmp_cache) == {"schema": warm.SCHEMA, "entries": {}}
+        with open(path, "w") as fh:
+            json.dump({"schema": -1, "entries": {"x": {}}}, fh)
+        assert warm.load_manifest(tmp_cache)["entries"] == {}
+
+
+class TestBenchWarmFirst:
+    """bench.py orders its stages warm-program-first off the manifest:
+    a cold flagship must not burn the budget ahead of a warm fallback."""
+
+    @staticmethod
+    def _bench():
+        import importlib.util
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(repo, "bench.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules.setdefault("bench", mod)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_cold_flagship_yields_to_warm_fallback(self, tmp_path, monkeypatch):
+        bench = self._bench()
+        d = str(tmp_path / "cache")
+        monkeypatch.setenv("LODESTAR_TPU_JAX_CACHE", d)
+        envk = warm.environment_key()
+        manifest = {
+            "schema": warm.SCHEMA,
+            "entries": {"hashed/b1024": {**envk, "cache_keys": []}},
+        }
+        warm.save_manifest(manifest, d)
+        assert bench._warm_first((4096, 1024)) == (1024, 4096)
+        # both warm (or both cold): flagship keeps the lead
+        manifest["entries"]["hashed/b4096"] = {**envk, "cache_keys": []}
+        warm.save_manifest(manifest, d)
+        assert bench._warm_first((4096, 1024)) == (4096, 1024)
+
+    def test_no_manifest_keeps_order(self, tmp_path, monkeypatch):
+        bench = self._bench()
+        monkeypatch.setenv("LODESTAR_TPU_JAX_CACHE", str(tmp_path / "none"))
+        assert bench._warm_first((4096, 1024)) == (4096, 1024)
+        assert bench._warm_first((8,)) == (8,)
+
+
+# ---------------------------------------------------------------------------
+# cache config + spy
+# ---------------------------------------------------------------------------
+
+
+class TestCacheConfig:
+    def test_configure_points_jax_at_dir(self, tmp_cache):
+        import jax
+
+        assert jax.config.jax_compilation_cache_dir == tmp_cache
+
+    def test_configure_env_override(self, tmp_path, monkeypatch):
+        d = str(tmp_path / "envcache")
+        monkeypatch.setenv("LODESTAR_TPU_JAX_CACHE", d)
+        assert aot_cache.repo_cache_dir() == d
+
+    def test_pin_cache_key_env(self):
+        env = {"XLA_FLAGS": "--xla_whatever", "OTHER": "1"}
+        aot_cache.pin_cache_key_env(env)
+        assert "XLA_FLAGS" not in env
+        assert env["OTHER"] == "1"
+
+    def test_spy_counts_miss_then_hit(self, tmp_cache):
+        """The persistent-cache spy must see a put+miss on first compile
+        and a hit when a fresh trace reloads the same program."""
+        events = []
+        aot_cache.install_cache_spy(lambda *e: events.append(e))
+        aot_cache.reset_stats()
+        prog = TinyProg(bucket=32, salt=3.25)
+        prog.fn()(*prog.example_args())  # compile -> miss + put
+        stats = aot_cache.cache_stats()
+        assert stats["misses"] >= 1
+        assert stats["puts"] >= 1
+        prog2 = TinyProg(bucket=32, salt=3.25)
+        prog2.fn()(*prog2.example_args())  # fresh jit object -> cache hit
+        assert aot_cache.cache_stats()["hits"] >= 1
+        kinds = {e[0] for e in events}
+        assert {"miss", "put", "hit"} <= kinds
+
+    def test_spy_callback_removal(self):
+        """remove_cache_spy_callback releases the callback (and its pool,
+        in the DeviceBlsVerifier close() path) — events stop arriving."""
+        events = []
+        cb = lambda *e: events.append(e)  # noqa: E731
+        aot_cache.install_cache_spy(cb)
+        aot_cache._emit("hit", "k-spy-removal", 0.1)
+        assert events
+        aot_cache.remove_cache_spy_callback(cb)
+        n = len(events)
+        aot_cache._emit("hit", "k-spy-removal", 0.1)
+        assert len(events) == n
+        # removing twice is a no-op, not an error
+        aot_cache.remove_cache_spy_callback(cb)
+
+    def test_entry_exists_both_layouts(self, tmp_path):
+        d = str(tmp_path)
+        open(os.path.join(d, "k1-cache"), "w").close()
+        open(os.path.join(d, "k2"), "w").close()
+        assert aot_cache.entry_exists(d, "k1")
+        assert aot_cache.entry_exists(d, "k2")
+        assert not aot_cache.entry_exists(d, "k3")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_check_empty_cache_fails(self, tmp_cache, capsys):
+        from lodestar_tpu.aot.__main__ import main
+
+        rc = main(["warm", "--check", "--json", "--cache-dir", tmp_cache])
+        assert rc == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] is False
+        assert len(out["programs"]) >= 5

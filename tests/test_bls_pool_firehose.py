@@ -32,14 +32,20 @@ class ModelledDevice:
     def __init__(self):
         self.jobs = []
 
-    def verify_signature_sets_device(self, sets):
+    def encode_job(self, sets, rand=None, bucket=None):
+        # host encode is cheap next to the device stage (and overlaps
+        # it in the pipelined pool); model it as free
+        return ("enc", list(sets))
+
+    def execute_batch(self, enc):
         # run_in_executor calls this in a worker thread: block like the
         # real chip would
+        _, sets = enc
         time.sleep(self.FLOOR_S + self.PER_SET_S * len(sets))
         self.jobs.append(len(sets))
         return True
 
-    def verify_each_device(self, sets):
+    def verify_each_device(self, sets, bucket=None):
         time.sleep(self.FLOOR_S + self.PER_SET_S * len(sets))
         return [True] * len(sets)
 
@@ -84,26 +90,36 @@ def test_firehose_p99_under_one_second():
 
 def test_latency_governor_caps_job_width():
     """The width governor (device_pool._latency_width_cap) must keep
-    steady-state jobs at or below the budget-derived width while still
-    reverting to max-width drain under genuine overload."""
+    steady-state jobs at or near the budget-derived width — aligned to
+    the pool compile rung the raw width pads into (ISSUE 5: an
+    unaligned cap like 882 would otherwise mint program shapes the AOT
+    warm registry never compiled) — while still reverting to
+    (rung-aligned) max-width drain under genuine overload."""
     from lodestar_tpu.chain.bls import device_pool as dp
+    from lodestar_tpu.ops.bls12_381 import buckets as bk
 
     pool = DeviceBlsVerifier(_backend=ModelledDevice())
     budget_width = int(
         (dp.LATENCY_BUDGET_S / 2 - dp.MODEL_FLOOR_S) / dp.MODEL_PER_SET_S
     )
 
-    # steady state: cap = budget width
+    # steady state: cap = budget width aligned up to the rung it would
+    # pad into anyway (same padded program, more sets served)
     pool._buffer_sigs = budget_width // 2
-    assert pool._latency_width_cap() == max(dp.MIN_JOB_WIDTH, budget_width)
+    assert pool._latency_width_cap() == bk.pool_bucket(
+        max(dp.MIN_JOB_WIDTH, budget_width)
+    )
     cap = pool._steady_width_cap()
+    assert cap in bk.POOL_BUCKETS
     # one max-size request's chunks + a capped job's worth of bystanders
     # must NOT count as overload (re-fusion guard)
     pool._buffer_sigs = dp.MAX_SIGNATURE_SETS_PER_JOB + cap
     assert pool._latency_width_cap() == cap
     # genuine overload: beyond that -> max-width drain
     pool._buffer_sigs = dp.MAX_SIGNATURE_SETS_PER_JOB + cap + 1
-    assert pool._latency_width_cap() == dp.MAX_SIGNATURE_SETS_PER_JOB
+    assert pool._latency_width_cap() == bk.align_down(
+        dp.MAX_SIGNATURE_SETS_PER_JOB
+    )
 
 
 def test_governed_pool_keeps_jobs_in_budget_at_offered_load():

@@ -19,19 +19,26 @@ from lodestar_tpu.utils import gather_settled
 
 
 class FakeBackend:
-    """Oracle-checked fake of ops.bls12_381.verify's host entry points."""
+    """Oracle-checked fake of ops.bls12_381.verify's two-stage backend
+    protocol (encode_job / execute_batch / verify_each_device)."""
 
     def __init__(self):
         self.batch_calls = []
         self.each_calls = []
+        self.encode_calls = []
 
-    def verify_signature_sets_device(self, sets):
+    def encode_job(self, sets, rand=None, bucket=None):
+        self.encode_calls.append((len(sets), bucket))
+        return ("enc", list(sets))
+
+    def execute_batch(self, enc):
         from lodestar_tpu.crypto.bls.api import verify_signature_set
 
+        _, sets = enc
         self.batch_calls.append(len(sets))
         return all(verify_signature_set(s) for s in sets)
 
-    def verify_each_device(self, sets):
+    def verify_each_device(self, sets, bucket=None):
         from lodestar_tpu.crypto.bls.api import verify_signature_set
 
         self.each_calls.append(len(sets))
@@ -83,7 +90,8 @@ class TestDevicePool:
         Asserted on the scheduled delays — deterministic on loaded CI."""
 
         class InstantBackend(FakeBackend):
-            def verify_signature_sets_device(self, sets):
+            def execute_batch(self, enc):
+                _, sets = enc
                 self.batch_calls.append(len(sets))
                 return True  # no oracle pairings needed here
 
@@ -152,6 +160,231 @@ class TestDevicePool:
             return await pool.verify_signature_sets([])
 
         assert run(go()) is False
+
+
+class TestPipelining:
+    """Encode/execute overlap (ISSUE 5 tentpole #3): the host encode of
+    job N+1 must start while job N still holds the device."""
+
+    class StageBackend(FakeBackend):
+        def __init__(self, encode_s=0.02, execute_s=0.12):
+            super().__init__()
+            self.events = []  # (event, n_sets) in wall order
+            self.encode_s = encode_s
+            self.execute_s = execute_s
+
+        def encode_job(self, sets, rand=None, bucket=None):
+            import time as _t
+
+            self.events.append(("encode_start", len(sets)))
+            _t.sleep(self.encode_s)
+            self.events.append(("encode_end", len(sets)))
+            return ("enc", list(sets))
+
+        def execute_batch(self, enc):
+            import time as _t
+
+            _, sets = enc
+            self.events.append(("execute_start", len(sets)))
+            _t.sleep(self.execute_s)
+            self.events.append(("execute_end", len(sets)))
+            return True
+
+    def test_encode_overlaps_device_execution(self):
+        # full-width (cap=4) requests flush immediately; only full-width
+        # packs are encoded ahead of a busy device (partial packs wait —
+        # see device_pool._flush), so both packs here qualify
+        backend = self.StageBackend()
+        pool = DeviceBlsVerifier(_backend=backend, max_sets_per_job=4)
+        opts = VerifyOptions(batchable=True)
+
+        async def go():
+            a = asyncio.ensure_future(
+                pool.verify_signature_sets(make_sets(4), opts)
+            )
+            # let pack A flush and enter its encode stage
+            await asyncio.sleep(0.005)
+            b = asyncio.ensure_future(
+                pool.verify_signature_sets(make_sets(4), opts)
+            )
+            return await gather_settled(a, b)
+
+        assert run(go()) == [True, True]
+        ev = backend.events
+        # pack B's encode (the second encode_start) must begin before
+        # pack A's device execution (the first execute_end) finishes
+        enc_starts = [i for i, (e, _) in enumerate(ev) if e == "encode_start"]
+        exec_ends = [i for i, (e, _) in enumerate(ev) if e == "execute_end"]
+        assert len(enc_starts) == 2 and len(exec_ends) == 2, ev
+        assert enc_starts[1] < exec_ends[0], (
+            f"no encode/execute overlap: {ev}"
+        )
+
+    def test_one_encode_at_a_time(self):
+        """The encode stage is serialized: pack C may not encode while
+        pack B still owns the encode stage."""
+        backend = self.StageBackend(encode_s=0.04, execute_s=0.04)
+        pool = DeviceBlsVerifier(_backend=backend, max_sets_per_job=4)
+        opts = VerifyOptions(batchable=True)
+
+        async def go():
+            futs = []
+            for _ in range(3):
+                futs.append(
+                    asyncio.ensure_future(
+                        pool.verify_signature_sets(make_sets(4), opts)
+                    )
+                )
+                await asyncio.sleep(0.01)
+            return await gather_settled(*futs)
+
+        assert all(run(go()))
+        depth = 0
+        for event, _ in backend.events:
+            if event == "encode_start":
+                depth += 1
+                assert depth == 1, f"concurrent encodes: {backend.events}"
+            elif event == "encode_end":
+                depth -= 1
+
+
+class TestGovernorBucketAlignment:
+    """ISSUE 5 tentpole #3: the governor's widths must be compile
+    buckets, so it can never mint a program shape the AOT warm registry
+    does not know about."""
+
+    def test_steady_cap_is_a_pool_rung(self):
+        from lodestar_tpu.chain.bls import device_pool as dp
+        from lodestar_tpu.ops.bls12_381 import buckets as bk
+
+        pool = DeviceBlsVerifier(_backend=FakeBackend())
+        cap = pool._steady_width_cap()
+        assert cap in bk.POOL_BUCKETS, f"steady cap {cap} not a pool rung"
+        # aligned UP to exactly the rung the raw model width (882 under
+        # the r4 fit) would pad into at dispatch — never further (that
+        # WOULD change the padded program and blow the latency budget)
+        raw = int((dp.LATENCY_BUDGET_S / 2 - dp.MODEL_FLOOR_S) / dp.MODEL_PER_SET_S)
+        assert cap == bk.pool_bucket(max(dp.MIN_JOB_WIDTH, raw))
+
+    def test_overload_drain_is_bucket_aligned(self):
+        from lodestar_tpu.chain.bls import device_pool as dp
+        from lodestar_tpu.ops.bls12_381 import buckets as bk
+
+        pool = DeviceBlsVerifier(_backend=FakeBackend())
+        cap = pool._steady_width_cap()
+        pool._buffer_sigs = dp.MAX_SIGNATURE_SETS_PER_JOB + cap + 1
+        drain = pool._latency_width_cap()
+        assert drain == bk.align_down(dp.MAX_SIGNATURE_SETS_PER_JOB)
+
+    def test_dispatch_bucket_reaches_backend(self):
+        """The pool passes its quantized pool-bucket width to the
+        backend encode so padded job shapes stay registered."""
+        from lodestar_tpu.ops.bls12_381 import buckets as bk
+
+        backend = FakeBackend()
+        pool = DeviceBlsVerifier(_backend=backend)
+
+        async def go():
+            return await pool.verify_signature_sets(
+                make_sets(3), VerifyOptions(batchable=True)
+            )
+
+        assert run(go()) is True
+        (n, bucket), = backend.encode_calls
+        assert n == 3
+        assert bucket == bk.pool_bucket(3, cap=pool._max_sets_per_job)
+        assert bucket in bk.POOL_BUCKETS
+
+
+class TestBucketSizeLargeBatches:
+    """bucket_size above 512 rounds to 512-multiples (ISSUE 5 satellite:
+    previously untested territory the governor can now reach)."""
+
+    def test_512_multiples(self):
+        from lodestar_tpu.ops.bls12_381.buckets import bucket_size
+
+        assert bucket_size(512) == 512
+        assert bucket_size(513) == 1024
+        assert bucket_size(1024) == 1024
+        assert bucket_size(1025) == 1536
+        assert bucket_size(2000) == 2048
+        assert bucket_size(2049) == 2560
+
+    def test_pool_bucket_quantization(self):
+        from lodestar_tpu.ops.bls12_381.buckets import (
+            POOL_BUCKETS,
+            pool_bucket,
+        )
+
+        assert pool_bucket(1) == 128
+        assert pool_bucket(129) == 512
+        assert pool_bucket(600) == 1024
+        assert pool_bucket(2048) == 2048
+        # tiny explicit pool caps fall back to the direct ladder
+        assert pool_bucket(3, cap=8) == 4
+        for n in (1, 100, 513, 1500):
+            assert pool_bucket(n) in POOL_BUCKETS
+
+    def test_pool_bucket_never_pads_past_cap(self):
+        """A non-rung cap (600) with n near it: no rung or ladder
+        bucket fits under the cap, so the cap itself is the width —
+        padding past an explicit cap would dispatch a wider program
+        than the pool promised."""
+        from lodestar_tpu.ops.bls12_381.buckets import pool_bucket
+
+        assert pool_bucket(600, cap=600) == 600
+        assert pool_bucket(550, cap=600) == 600
+        # a rung below the cap still wins when it holds n
+        assert pool_bucket(400, cap=600) == 512
+
+
+class TestCloseSettlesInflight:
+    """ISSUE 5 satellite: close() must cancel-and-settle in-flight
+    jobs, not strand them."""
+
+    def test_close_settles_running_job(self):
+        backend = TestPipelining.StageBackend(encode_s=0.01, execute_s=0.3)
+        pool = DeviceBlsVerifier(_backend=backend, max_sets_per_job=4)
+
+        async def go():
+            fut = asyncio.ensure_future(
+                pool.verify_signature_sets(
+                    make_sets(4), VerifyOptions(batchable=True)
+                )
+            )
+            await asyncio.sleep(0.05)  # job is mid-execute on the device
+            assert pool._tasks, "no in-flight job task to settle"
+            await pool.close()
+            assert not [t for t in pool._tasks if not t.done()], (
+                "close left an unsettled job task"
+            )
+            with pytest.raises(RuntimeError):
+                await fut
+
+        run(go())
+
+    def test_no_flush_after_close(self):
+        pool = DeviceBlsVerifier(_backend=FakeBackend())
+
+        async def go():
+            import time as _t
+
+            from lodestar_tpu.chain.bls.device_pool import _BufferedJob
+
+            await pool.close()
+            # a stale timer firing after close must not dispatch
+            loop = asyncio.get_running_loop()
+            pool._buffer.append(
+                _BufferedJob(
+                    sets=make_sets(1),
+                    future=loop.create_future(),
+                    added_at=_t.monotonic(),
+                )
+            )
+            pool._flush()
+            assert not pool._tasks
+
+        run(go())
 
 
 class TestSingleThreadVerifier:

@@ -284,6 +284,52 @@ def test_jit_in_func_negative_in_tests_dir():
     assert not lint(src, path="tests/test_kernel.py", rule="jit-in-func")
 
 
+def test_unregistered_jit_positive_module_scope():
+    # the exact pre-ISSUE-5 pattern from ops/bls12_381/verify.py: ad-hoc
+    # module-level jit closures the warm tool can't enumerate
+    src = """
+    import jax
+    _jit_batch = jax.jit(verify_signature_sets)
+    """
+    assert [f.rule for f in lint(src, rule="unregistered-jit")]
+
+
+def test_unregistered_jit_positive_decorator():
+    src = """
+    import jax
+    @jax.jit
+    def kernel(x):
+        return x
+    """
+    assert [f.rule for f in lint(src, rule="unregistered-jit")]
+
+
+def test_unregistered_jit_negative_registry_and_scope():
+    src = """
+    import jax
+    _jit = jax.jit(fn)
+    """
+    # the registry itself is the one allowed construction site
+    assert not lint(
+        src, path="lodestar_tpu/aot/registry.py", rule="unregistered-jit"
+    )
+    # outside lodestar_tpu/ (tools, tests, bench) is out of scope
+    assert not lint(src, path="tools/probe.py", rule="unregistered-jit")
+    assert not lint(src, path="tests/test_x.py", rule="unregistered-jit")
+
+
+def test_unregistered_jit_negative_in_function():
+    # in-function construction is jit-in-func's finding, not this rule's
+    src = """
+    import jax
+    import functools
+    @functools.lru_cache(maxsize=None)
+    def jitted(kernel):
+        return jax.jit(KERNELS[kernel])
+    """
+    assert not lint(src, rule="unregistered-jit")
+
+
 def test_static_unhashable_positive():
     src = """
     import jax

@@ -28,7 +28,12 @@ import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CACHE = os.path.join(REPO, ".jax_cache")
+sys.path.insert(0, REPO)
+from lodestar_tpu.aot import cache as _aot_cache  # noqa: E402
+
+# ONE cache-location source of truth (ISSUE 5): the same repo_cache_dir
+# every other entry point gets from aot.cache.configure()
+CACHE = _aot_cache.repo_cache_dir()
 
 _CHILD = r"""
 import os, sys, time
@@ -40,8 +45,11 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
-jax.config.update("jax_compilation_cache_dir", sys.argv[1])
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+sys.path.insert(0, sys.argv[2])
+from lodestar_tpu.aot import cache as aot_cache
+# probe cache lives in a TEMP dir (round-trip isolation) with the
+# min-compile threshold at 0 so the tiny probe program gets an entry
+aot_cache.configure(sys.argv[1], min_compile_time_secs=0.0)
 
 @jax.jit
 def f(x):
@@ -77,7 +85,7 @@ def scrub_axon_env(environ) -> dict:
 def _run_child(cache_dir: str) -> str:
     try:
         out = subprocess.run(
-            [sys.executable, "-c", _CHILD, cache_dir],
+            [sys.executable, "-c", _CHILD, cache_dir, REPO],
             capture_output=True, text=True, timeout=300,
             env=scrub_axon_env(os.environ),
         )

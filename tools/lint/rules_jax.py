@@ -100,6 +100,64 @@ class JitInFunc(Rule):
         return out
 
 
+@register
+class UnregisteredJit(Rule):
+    id = "unregistered-jit"
+    description = (
+        "module-scope jax.jit in lodestar_tpu/ outside the AOT registry: "
+        "the registry (lodestar_tpu/aot/registry.py) is the single source "
+        "of truth for every program `python -m lodestar_tpu.aot warm` must "
+        "compile — a jit wrapper minted elsewhere is invisible to the warm "
+        "manifest and pays a cold multi-minute compile at first dispatch"
+    )
+
+    # the one module allowed to construct jit wrappers: the registry's
+    # memoized jitted() factory hands THE per-kernel wrapper to everyone
+    _REGISTRY = "lodestar_tpu/aot/registry.py"
+
+    def applies(self, path: str) -> bool:
+        return (
+            path.startswith("lodestar_tpu/")
+            and path.endswith(".py")
+            and path != self._REGISTRY
+        )
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        for node in walk_tree(tree):
+            # @jax.jit / @partial(jax.jit, ...) on a module-level def is
+            # a module-scope program too (the decorator list belongs to
+            # the enclosing scope, so nearest_function is None for it)
+            if isinstance(node, ast.Call) and _is_jit_construction(node):
+                if nearest_function(node) is not None:
+                    continue  # in-function construction: jit-in-func's job
+                out.append(
+                    self.finding(
+                        path,
+                        node,
+                        "module-scope jax.jit outside the AOT registry; "
+                        "route through lodestar_tpu.aot.registry.jitted() "
+                        "so the warm tool knows this program exists",
+                    )
+                )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if nearest_function(node) is not None:
+                    continue
+                for dec in node.decorator_list:
+                    if dotted_name(dec) in _JIT_NAMES:
+                        out.append(
+                            self.finding(
+                                path,
+                                dec,
+                                "module-scope @jax.jit outside the AOT "
+                                "registry; route through "
+                                "lodestar_tpu.aot.registry.jitted() so the "
+                                "warm tool knows this program exists",
+                            )
+                        )
+        return out
+
+
 def _static_positions(call: ast.Call) -> Tuple[Set[int], Set[str]]:
     """static_argnums / static_argnames literals of a jit(...) call."""
     nums: Set[int] = set()
