@@ -136,6 +136,16 @@ def run_config(batch: int, iters: int, cap_s: float | None = None) -> dict:
     p99_s = times[min(len(times) - 1, int(0.99 * len(times)))]
     sigs_per_sec = B / mean_s
 
+    # Honesty stamp (ISSUE 7): if ANY verification in this process ran
+    # on a degraded tier (per-set fallback, host oracle) or the device
+    # breaker tripped, the stage JSON says so — a future driver round
+    # that silently ran on the host fallback must not bank a number
+    # that looks like device throughput.  Armed fault injections are
+    # stamped for the same reason (a chaos-harness run is not a bench).
+    from lodestar_tpu.chain.bls import breaker as _breaker
+    from lodestar_tpu.testing import faults as _faults
+
+    degradation = _breaker.process_degradation()
     return {
         "metric": "bls_e2e_verify_sigs_per_sec_per_chip",
         "value": round(sigs_per_sec, 1),
@@ -148,6 +158,9 @@ def run_config(batch: int, iters: int, cap_s: float | None = None) -> dict:
         "host_hash_ms": round(sum(host_times) / len(host_times) * 1e3, 2),
         "compile_s": round(compile_s, 1),
         "persistent_cache": cache_state,
+        "degradation_tier": degradation["worst_tier"],
+        "breaker_state": degradation["breaker_state"],
+        "fault_injection": _faults.active(),
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
     }

@@ -1,6 +1,7 @@
-"""CLI: ``python -m lodestar_tpu.aot warm [--check]`` — compile the
-registered BLS programs into the persistent cache (resumable), or
-verify they are all present and fresh.
+"""CLI: ``python -m lodestar_tpu.aot warm [--check|--heal]`` — compile
+the registered BLS programs into the persistent cache (resumable),
+verify they are all present/fresh/uncorrupted, or quarantine-and-
+recompile poisoned entries (docs/AOT.md troubleshooting).
 
 Also reachable as ``lodestar-tpu aot warm|check`` (cli/main.py).
 """
@@ -41,6 +42,14 @@ def main(argv=None) -> int:
         "full: every direct-call bucket as well",
     )
     w.add_argument(
+        "--heal",
+        action="store_true",
+        help="load-round-trip every registered program: quarantine "
+        "corrupt/undeserializable cache entries (bytes preserved under "
+        ".jax_cache/quarantine/) and recompile them; healthy entries "
+        "are untouched (see docs/AOT.md troubleshooting)",
+    )
+    w.add_argument(
         "--budget-s",
         type=float,
         default=None,
@@ -58,6 +67,11 @@ def main(argv=None) -> int:
     if args.command != "warm":
         ap.print_help()
         return 1
+    if args.heal and (args.check or args.list):
+        # --check/--list are read-only; silently ignoring --heal would
+        # leave an operator believing the poisoned entry was fixed
+        ap.error("--heal cannot be combined with --check/--list "
+                 "(run --heal first, then --check)")
 
     # The persistent-cache key includes compile options: pin the env the
     # same way bench.py pins its child stages, BEFORE jax initializes,
@@ -103,17 +117,34 @@ def main(argv=None) -> int:
         )
         return 3
     try:
-        report = warm.warm_programs(
-            programs,
-            cache_dir=args.cache_dir,
-            budget_s=args.budget_s,
-            do_export=not args.no_export,
-            log=lambda m: print(m, file=sys.stderr, flush=True),
-        )
+        if args.heal:
+            report = warm.heal_programs(
+                programs,
+                cache_dir=args.cache_dir,
+                budget_s=args.budget_s,
+                do_export=not args.no_export,
+                log=lambda m: print(m, file=sys.stderr, flush=True),
+            )
+        else:
+            report = warm.warm_programs(
+                programs,
+                cache_dir=args.cache_dir,
+                budget_s=args.budget_s,
+                do_export=not args.no_export,
+                log=lambda m: print(m, file=sys.stderr, flush=True),
+            )
     finally:
         lock_fh.close()
     if args.json:
         print(json.dumps(report, indent=2))
+    elif args.heal:
+        print(
+            f"aot heal: {len(report['healthy'])} healthy, "
+            f"{len(report['healed'])} healed, "
+            f"{len(report['stale_rewarmed'])} re-warmed, "
+            f"{len(report['quarantined'])} file(s) quarantined, "
+            f"{len(report['deferred'])} deferred"
+        )
     else:
         print(
             f"aot warm: {len(report['compiled'])} compiled, "

@@ -17,9 +17,12 @@ events into the Prometheus family.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Callable, Dict, List, Optional
+
+_log = logging.getLogger(__name__)
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -31,9 +34,28 @@ DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
 DEFAULT_MIN_COMPILE_SECS = 1.0
 
 
+def cache_generation() -> str:
+    """The operator-bumpable cache-generation salt (ROADMAP item 3).
+
+    jax never rewrites a cache key whose entry exists-but-fails-to-load,
+    so a poisoned entry under the OLD key survives recompiles forever.
+    The spy's quarantine path (below) heals that in-process; the salt is
+    the out-of-band hammer: bump ``LODESTAR_TPU_CACHE_GENERATION`` once
+    and every program re-warms into a fresh ``gen-<salt>`` subdirectory
+    while the old entries stay untouched on disk (never delete
+    ``.jax_cache``).  The salt is also mixed into the trace-replay cache
+    key (ops/bls12_381/opcache._env_key) so nothing in the process
+    straddles generations."""
+    return os.environ.get("LODESTAR_TPU_CACHE_GENERATION", "").strip()
+
+
 def repo_cache_dir() -> str:
-    """The repo-local persistent cache (override: LODESTAR_TPU_JAX_CACHE)."""
-    return os.environ.get("LODESTAR_TPU_JAX_CACHE", DEFAULT_CACHE_DIR)
+    """The effective persistent-cache dir (override: LODESTAR_TPU_JAX_CACHE;
+    salted into a ``gen-<salt>`` subdir when LODESTAR_TPU_CACHE_GENERATION
+    is set — see cache_generation)."""
+    base = os.environ.get("LODESTAR_TPU_JAX_CACHE", DEFAULT_CACHE_DIR)
+    gen = cache_generation()
+    return os.path.join(base, f"gen-{gen}") if gen else base
 
 
 def configure(
@@ -55,9 +77,7 @@ def configure(
         # cold.  Warn — don't silently strip: XLA_FLAGS can be a
         # deliberate operator choice (e.g. the multichip dryrun's
         # host_platform_device_count).
-        import logging
-
-        logging.getLogger(__name__).warning(
+        _log.warning(
             "XLA_FLAGS is set: persistent compilation-cache keys will "
             "not match `python -m lodestar_tpu.aot warm` (which clears "
             "it) — warmed programs may recompile cold"
@@ -93,7 +113,7 @@ def pin_cache_key_env(environ: Optional[Dict[str, str]] = None) -> None:
 _spy_lock = threading.Lock()
 _SPY: Dict[str, object] = {"installed": False}
 _CALLBACKS: List[Callable[[str, str, float], None]] = []
-_STATS = {"hits": 0, "misses": 0, "puts": 0}
+_STATS = {"hits": 0, "misses": 0, "puts": 0, "load_errors": 0}
 _KEYS: Dict[str, str] = {}  # cache_key -> last event kind
 
 
@@ -116,7 +136,61 @@ def install_cache_spy(
         orig_put = cc.put_executable_and_time
 
         def spy_get(cache_key, *args, **kwargs):
-            executable, compile_time = orig_get(cache_key, *args, **kwargs)
+            from lodestar_tpu.testing import faults
+
+            try:
+                try:
+                    faults.fire("aot.cache.get", cache_key=cache_key)
+                    executable, compile_time = orig_get(
+                        cache_key, *args, **kwargs
+                    )
+                except Exception as first_err:
+                    # retry ONCE before declaring the entry poisoned: a
+                    # transient I/O hiccup (flaky disk/NFS read) must
+                    # not evict a healthy entry and force a multi-
+                    # minute recompile — genuine deserialization
+                    # failures are deterministic and fail again
+                    _log.debug(
+                        "persistent-cache load of %s failed once "
+                        "(%s: %s); retrying before quarantine",
+                        cache_key, type(first_err).__name__, first_err,
+                    )
+                    faults.fire("aot.cache.get", cache_key=cache_key)
+                    executable, compile_time = orig_get(
+                        cache_key, *args, **kwargs
+                    )
+            except Exception as e:
+                # Self-heal (tentpole b): the entry EXISTS but cannot
+                # deserialize — the one known production fault (a
+                # poisoned 111 MB pairing entry kept full-pairing
+                # multichip red for five rounds, because jax never
+                # rewrites a failed-load key).  Quarantine the corrupt
+                # bytes aside and report a MISS: jax recompiles and the
+                # following put writes a fresh entry under the same key.
+                try:
+                    quarantined = quarantine_entry(
+                        _current_cache_dir(), cache_key
+                    )
+                except OSError as qe:
+                    # a read-only/permission-locked cache dir: the
+                    # quarantine is best-effort — still degrade to a
+                    # miss so the compile proceeds (the poisoned file
+                    # stays, but this process gets its executable)
+                    _log.warning(
+                        "could not quarantine poisoned entry %s (%s: "
+                        "%s)", cache_key, type(qe).__name__, qe,
+                    )
+                    quarantined = None
+                _log.warning(
+                    "persistent-cache entry %s failed to load (%s: %s); "
+                    "quarantined to %s — recompiling",
+                    cache_key,
+                    type(e).__name__,
+                    e,
+                    quarantined or "<no file found>",
+                )
+                _emit("load_error", cache_key, 0.0)
+                return None, None
             if executable is not None:
                 _emit("hit", cache_key, float(compile_time or 0))
             else:
@@ -124,6 +198,9 @@ def install_cache_spy(
             return executable, compile_time
 
         def spy_put(cache_key, *args, **kwargs):
+            from lodestar_tpu.testing import faults
+
+            faults.fire("aot.cache.put", cache_key=cache_key)
             # signature: (cache_key, module_name, executable, backend,
             # compile_time:int seconds)
             seconds = 0.0
@@ -132,8 +209,26 @@ def install_cache_spy(
                     seconds = float(args[-1])
                 except (TypeError, ValueError):
                     seconds = 0.0
+            # is this put the rewrite half of a self-heal?  (load_error
+            # was this key's last event before the recompile)
+            healed = _KEYS.get(cache_key) == "load_error"
             _emit("put", cache_key, seconds)
-            return orig_put(cache_key, *args, **kwargs)
+            result = orig_put(cache_key, *args, **kwargs)
+            if healed:
+                # re-stamp the warm manifest's entry hash: the healed
+                # bytes need not match the warm-time fingerprint, and a
+                # stale hash would make the next `warm --check` call
+                # this freshly-healed entry "corrupt"
+                try:
+                    from lodestar_tpu.aot import warm as _warm
+
+                    _warm.refresh_entry_hash(_current_cache_dir(), cache_key)
+                except Exception as e:
+                    _log.debug(
+                        "manifest hash refresh after self-heal failed: "
+                        "%s: %s", type(e).__name__, e,
+                    )
+            return result
 
         cc.get_executable_and_time = spy_get
         cc.put_executable_and_time = spy_put
@@ -157,7 +252,12 @@ def remove_cache_spy_callback(
             pass
 
 
-_STAT_KEY = {"hit": "hits", "miss": "misses", "put": "puts"}
+_STAT_KEY = {
+    "hit": "hits",
+    "miss": "misses",
+    "put": "puts",
+    "load_error": "load_errors",
+}
 
 
 def _emit(kind: str, cache_key: str, seconds: float) -> None:
@@ -167,8 +267,12 @@ def _emit(kind: str, cache_key: str, seconds: float) -> None:
     for cb in list(_CALLBACKS):
         try:
             cb(kind, cache_key, seconds)
-        except Exception:
-            pass  # a metrics sink must never break a compile
+        except Exception as e:
+            # a metrics sink must never break a compile — but a broken
+            # sink must not be invisible either
+            _log.debug(
+                "cache-spy callback failed: %s: %s", type(e).__name__, e
+            )
 
 
 def cache_stats() -> Dict[str, int]:
@@ -194,3 +298,68 @@ def entry_exists(cache_dir: str, cache_key: str) -> bool:
     return os.path.isfile(os.path.join(cache_dir, cache_key + "-cache")) or (
         os.path.isfile(os.path.join(cache_dir, cache_key))
     )
+
+
+def entry_paths(cache_dir: str, cache_key: str) -> List[str]:
+    """On-disk file(s) holding ``cache_key``'s entry (either layout)."""
+    out = []
+    for suffix in ("-cache", ""):
+        p = os.path.join(cache_dir, cache_key + suffix)
+        if os.path.isfile(p):
+            out.append(p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# corrupt-entry quarantine (self-healing cache — tentpole b)
+# ---------------------------------------------------------------------------
+
+QUARANTINE_DIR = "quarantine"
+
+
+def quarantine_dir(cache_dir: str) -> str:
+    return os.path.join(cache_dir, QUARANTINE_DIR)
+
+
+def quarantine_entry(cache_dir: str, cache_key: str) -> Optional[str]:
+    """Move a corrupt entry's file(s) into ``<cache>/quarantine/``,
+    preserving the bytes for post-mortem — NEVER delete, and never
+    touch any other entry.  Returns the first quarantined path (None if
+    no file was on disk).  Name collisions from repeated poisonings get
+    a numeric suffix instead of overwriting earlier evidence."""
+    moved: Optional[str] = None
+    qdir = quarantine_dir(cache_dir)
+    for src in entry_paths(cache_dir, cache_key):
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, os.path.basename(src))
+        n = 1
+        while os.path.exists(dst):
+            dst = os.path.join(qdir, f"{os.path.basename(src)}.{n}")
+            n += 1
+        os.replace(src, dst)
+        moved = moved or dst
+    return moved
+
+
+def quarantined_files(cache_dir: str) -> List[str]:
+    qdir = quarantine_dir(cache_dir)
+    if not os.path.isdir(qdir):
+        return []
+    return sorted(
+        os.path.join(qdir, f) for f in os.listdir(qdir)
+        if os.path.isfile(os.path.join(qdir, f))
+    )
+
+
+def _current_cache_dir() -> str:
+    """The dir jax is ACTUALLY using right now (falls back to the
+    configured repo dir when jax has none set)."""
+    try:
+        import jax
+
+        d = jax.config.jax_compilation_cache_dir
+        if d:
+            return d
+    except ImportError:  # no jax in this process: the configured default
+        pass
+    return repo_cache_dir()

@@ -35,8 +35,13 @@ class ApiClient(ReusedClientSession):
                 try:
                     body = await resp.json()
                     msg = body.get("message", "")
-                except Exception:
-                    msg = await resp.text()
+                except Exception as e:
+                    # unparseable error body: surface the raw text (and
+                    # the parse failure) through the ApiError instead
+                    msg = (
+                        await resp.text()
+                        or f"<unparseable error body: {type(e).__name__}>"
+                    )
                 raise ApiError(resp.status, msg)
             return await resp.json() if resp.content_type == "application/json" else {}
 

@@ -4,9 +4,28 @@ Reference semantics (packages/beacon-node/src/chain/bls/multithread/):
   * batchable sets buffer up to MAX_BUFFERED_SIGS=32 or MAX_BUFFER_WAIT_MS=
     100 ms, whichever first (index.ts:48,57)
   * at most MAX_SIGNATURE_SETS_PER_JOB=128 sets per device job (index.ts:39)
-  * a failed batch falls back to per-set verification — here a single
-    vmapped kernel instead of the worker's serial loop (worker.ts:76-98)
   * non-batchable requests dispatch immediately
+
+Fault-domain ladder (tiers engage strictly in order, per job):
+  1. **device batch** — the padded batch kernel.  A batch VERDICT of
+     ``False`` (some set invalid) is not a fault: it goes straight to
+     the vmapped per-set kernel to split good from bad, mirroring the
+     reference's retry-each-individually (worker.ts:76-98 /
+     maybeBatch.ts:17).
+  2. **device retry** — a device *exception* (XLA runtime error,
+     compile crash) gets ONE immediate re-dispatch; transient faults
+     end here.
+  3. **device per-set** — if the retry also faults, the vmapped per-set
+     kernel (``verify_each_device``, in the AOT warm registry) is tried.
+  4. **host** — last resort: the CPU oracle verifies the pack
+     (batch-then-per-set, SingleThreadBlsVerifier semantics).  Waiters
+     always receive boolean verdicts for device faults; only host-side
+     failures (encode bugs, close()) surface as exceptions.
+A circuit breaker (chain/bls/breaker.py) watches consecutive
+device-fault jobs: after N it trips and packs go straight to tier 4
+without paying the device timeout, then a half-open canary job probes
+the device on exponential backoff.  Breaker state and per-tier
+engagement counters are exported through BlsPoolMetrics.
 
 The "pool" is the device itself: jobs run one at a time on the chip via an
 asyncio lock (XLA serializes kernels anyway), with the batching window
@@ -22,7 +41,10 @@ from typing import List, Optional, Sequence
 
 from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_set
 from lodestar_tpu.ops.bls12_381 import buckets as bk
-from lodestar_tpu.utils import gather_settled
+from lodestar_tpu.testing import faults
+from lodestar_tpu.utils import gather_settled, get_logger
+from . import breaker as brk
+from .breaker import DeviceCircuitBreaker
 from .interface import VerifyOptions
 from .metrics import BlsPoolMetrics
 
@@ -86,6 +108,7 @@ class DeviceBlsVerifier:
         metrics: Optional[BlsPoolMetrics] = None,
         _backend=None,
         max_sets_per_job: int = MAX_SIGNATURE_SETS_PER_JOB,
+        breaker: Optional[DeviceCircuitBreaker] = None,
     ):
         # _backend injection point for tests (defaults to the jit kernels)
         is_production_backend = _backend is None
@@ -101,6 +124,8 @@ class DeviceBlsVerifier:
 
             _backend = dv
         self._dv = _backend
+        self._breaker = breaker if breaker is not None else DeviceCircuitBreaker()
+        self._log = get_logger("bls-pool")
         self._max_sets_per_job = max_sets_per_job
         self._buffer: List[_BufferedJob] = []
         self._buffer_sigs = 0
@@ -207,7 +232,11 @@ class DeviceBlsVerifier:
 
             aot_cache.install_cache_spy(self._on_cache_event)
             self._cache_spy_cb = self._on_cache_event
-        except Exception:
+        except Exception as e:
+            self._log.debug(
+                f"persistent-cache spy unavailable "
+                f"({type(e).__name__}: {e}); compile observability off"
+            )
             return
 
         def _freshness() -> None:
@@ -218,7 +247,12 @@ class DeviceBlsVerifier:
             try:
                 from lodestar_tpu.aot import registry, warm
 
-                ok, rows = warm.check_programs(registry.registered_programs())
+                # check_hashes=False: the gauge needs freshness, not
+                # byte integrity — hashing every entry file reads
+                # hundreds of MB at pool start on a 2-core host
+                ok, rows = warm.check_programs(
+                    registry.registered_programs(), check_hashes=False
+                )
                 metrics.warm_manifest_fresh.set(1 if ok else 0)
                 metrics.warm_programs_total.set(len(rows))
                 metrics.warm_programs_warm.set(
@@ -242,6 +276,10 @@ class DeviceBlsVerifier:
             m.persistent_cache_misses.inc()
         elif kind == "put":
             m.compile_time.observe(seconds)
+        elif kind == "load_error":
+            # poisoned persistent-cache entry: the spy quarantined it
+            # and jax recompiled (aot/cache.py self-heal path)
+            m.persistent_cache_load_errors.inc()
 
     # ------------------------------------------------------------------
 
@@ -309,14 +347,23 @@ class DeviceBlsVerifier:
         if self._closed or not self._buffer or self._encoding:
             return
         width_cap = self._latency_width_cap()
-        if self._device_lock.locked() and self._buffer_sigs < width_cap:
+        if (
+            self._device_lock.locked()
+            and self._buffer_sigs < width_cap
+            and self._breaker.state == brk.CLOSED
+        ):
             # The device is busy and the backlog can't fill a full-width
             # pack: forming a partial pack EARLY would pay an extra
             # kernel floor and deepen worst-case queueing for zero
             # throughput gain — only full-width packs are worth encoding
             # ahead of the device.  Re-arm the window; the running
             # pack's completion (or the backlog reaching full width)
-            # re-triggers us sooner.
+            # re-triggers us sooner.  ONLY while the breaker is CLOSED:
+            # open-state packs (and half-open bystanders of a wedged
+            # canary) go to the host verifier and never touch the
+            # device — deferring them behind a wedged device job would
+            # stall sub-cap traffic for exactly as long as the
+            # short-circuit promises not to.
             self._schedule_flush(MAX_BUFFER_WAIT_MS / 1000)
             return
         pack: List[_BufferedJob] = []
@@ -396,30 +443,56 @@ class DeviceBlsVerifier:
         loop = asyncio.get_running_loop()
         t0 = time.monotonic()
         bucket = bk.pool_bucket(len(all_sets), cap=self._max_sets_per_job)
-        encoded = await loop.run_in_executor(
-            None, lambda: self._dv.encode_job(all_sets, bucket=bucket)
+        # breaker decision comes BEFORE the encode stage: while the
+        # breaker is open the pack goes to the host verifier, which
+        # never touches the encoded tensors — paying the device encode
+        # (expand_message_xmd + limb packing) would double the host CPU
+        # cost exactly when the host is already carrying verification
+        decision = self._breaker.allow_device()
+        probe_token = (
+            self._breaker.probe_token if decision == "canary" else None
         )
-        if self._metrics:
-            self._metrics.encode_time.observe(time.monotonic() - t0)
-        async with self._device_lock:
-            # we own the device: free the encode stage for pack N+1
-            # (only the buffered-flush path owns the encode stage — an
-            # immediate-dispatch job must not release someone else's)
-            if encode_owner is not None and encode_owner["encode"]:
-                encode_owner["encode"] = False
-                self._release_encode()
-            batch_ok = await loop.run_in_executor(
-                None, self._dv.execute_batch, encoded
-            )
-            if batch_ok:
-                per_set: Optional[List[bool]] = None
-            else:
-                # batch failed: one vmapped per-set pass splits good from bad
-                if self._metrics:
-                    self._metrics.batch_retries.inc()
-                per_set = await loop.run_in_executor(
-                    None, lambda: self._dv.verify_each_device(all_sets, bucket=bucket)
+        try:
+            if decision == "host":
+                # breaker open: no encode, and no device lock either —
+                # the short-circuit exists to NOT wait on the chip, and
+                # a wedged in-flight device job may hold the lock for
+                # its whole multi-second failure ladder.  Free the
+                # encode stage now (this pack never uses it) and serve
+                # the verdicts from the host oracle directly.
+                if encode_owner is not None and encode_owner["encode"]:
+                    encode_owner["encode"] = False
+                    self._release_encode()
+                per_set = await self._verify_with_ladder(
+                    loop, decision, None, all_sets, bucket
                 )
+            else:
+                encoded = await loop.run_in_executor(
+                    None, self._encode_host, all_sets, bucket
+                )
+                if self._metrics:
+                    self._metrics.encode_time.observe(time.monotonic() - t0)
+                async with self._device_lock:
+                    # we own the device: free the encode stage for pack
+                    # N+1 (only the buffered-flush path owns the encode
+                    # stage — an immediate-dispatch job must not release
+                    # someone else's)
+                    if encode_owner is not None and encode_owner["encode"]:
+                        encode_owner["encode"] = False
+                        self._release_encode()
+                    per_set = await self._verify_with_ladder(
+                        loop, decision, encoded, all_sets, bucket
+                    )
+        except BaseException:
+            # anything escaping before the probe's outcome landed —
+            # close() cancellation, an encode-stage fault — must not
+            # leak the half-open canary slot forever.  The token scopes
+            # the release to THIS job's probe: once this canary was
+            # resolved (or a newer one admitted), cancel_probe is a
+            # no-op, so this over-approximates safely.
+            if decision == "canary":
+                self._breaker.cancel_probe(probe_token)
+            raise
         # device released: wake any deferred partial pack NOW.  The
         # buffered path also schedules from _run_pack's finally, but the
         # immediate-dispatch path reaches the lock only through here —
@@ -447,6 +520,155 @@ class DeviceBlsVerifier:
                 job.future.set_result(ok)
             ok_all = ok_all and ok
         return ok_all
+
+    # ------------------------------------------------------------------
+    # degradation ladder (tentpole: waiters get verdicts, not exceptions)
+    # ------------------------------------------------------------------
+
+    def _encode_host(self, all_sets: List[SignatureSet], bucket: int):
+        faults.fire("bls.host.encode")
+        return self._dv.encode_job(all_sets, bucket=bucket)
+
+    def _execute_device(self, encoded):
+        faults.fire("bls.device.execute")
+        return self._dv.execute_batch(encoded)
+
+    def _each_device(self, all_sets: List[SignatureSet], bucket: int):
+        faults.fire("bls.device.each")
+        return self._dv.verify_each_device(all_sets, bucket=bucket)
+
+    @staticmethod
+    def _host_verify_pack(all_sets: List[SignatureSet]) -> Optional[List[bool]]:
+        """CPU oracle verdicts for a pack (SingleThreadBlsVerifier
+        semantics: one batched check, per-set split only on failure)."""
+        from lodestar_tpu.crypto.bls.api import verify_multiple_signature_sets
+
+        if verify_multiple_signature_sets(list(all_sets)):
+            return None
+        return [verify_signature_set(s) for s in all_sets]
+
+    async def _verify_with_ladder(
+        self, loop, decision: str, encoded, all_sets: List[SignatureSet],
+        bucket: int
+    ) -> Optional[List[bool]]:
+        """Per-set verdicts for one pack (``None`` == every set valid),
+        degrading through the tiers in the module docstring.  The
+        caller made the breaker ``decision`` before the encode stage
+        and holds the device lock for every decision EXCEPT "host" (an
+        open breaker skips encode and lock alike — the short-circuit
+        must not wait on a wedged chip).  Device *exceptions* never
+        reach the waiters — only verdicts do; CancelledError always
+        propagates (the caller releases an unresolved canary probe)."""
+        m = self._metrics
+        if decision == "host":
+            # breaker open: don't pay the device timeout at all
+            if m:
+                m.breaker_short_circuits.inc()
+            self._note_tier(brk.TIER_HOST)
+            return await loop.run_in_executor(
+                None, self._host_verify_pack, all_sets
+            )
+        if decision == "canary" and m:
+            m.breaker_probes.inc()
+
+        # tiers 1+2: batch kernel, one retry on a device fault (a canary
+        # gets no retry — its job is to answer "is the device back?"
+        # cheaply, and a second failing dispatch answers nothing new)
+        attempts = 1 if decision == "canary" else 2
+        batch_ok: Optional[bool] = None
+        for attempt in range(attempts):
+            if attempt:
+                self._note_tier(brk.TIER_DEVICE_RETRY)
+            try:
+                batch_ok = await loop.run_in_executor(
+                    None, self._execute_device, encoded
+                )
+                break
+            except Exception as e:
+                self._on_device_fault("execute_batch", attempt, e)
+        if batch_ok is not None:
+            if batch_ok:
+                self._device_recovered(probe=decision == "canary")
+                return None
+            # batch verdict False — NOT a fault: split good from bad
+            if m:
+                m.batch_retries.inc()
+        elif decision == "canary":
+            # failed canary: breaker re-opens; settle the pack on host
+            self._record_breaker_failure(probe=True)
+            self._note_tier(brk.TIER_HOST)
+            return await loop.run_in_executor(
+                None, self._host_verify_pack, all_sets
+            )
+
+        # tier 3: vmapped per-set kernel (also the verdict-split path)
+        try:
+            per_set = await loop.run_in_executor(
+                None, self._each_device, all_sets, bucket
+            )
+            if batch_ok is None:
+                # the batch kernel faulted but per-set answered: the
+                # device works — count the tier, clear the fault streak
+                self._note_tier(brk.TIER_PER_SET)
+            self._device_recovered(probe=decision == "canary")
+            return per_set
+        except Exception as e:
+            self._on_device_fault("verify_each", attempts, e)
+
+        # tier 4: the host oracle — correct verdicts, no device.  Only
+        # a job where NO device dispatch succeeded counts against the
+        # breaker: a working batch kernel whose per-set split faulted
+        # is a partial fault, and tripping on it would evict a device
+        # that demonstrably still answers the steady-state kernel.
+        if batch_ok is None:
+            self._record_breaker_failure(probe=decision == "canary")
+        else:
+            # the batch kernel answered (the steady-state path works):
+            # for breaker purposes the device is healthy — this also
+            # resolves a canary probe that got here via a verdict split
+            self._device_recovered(probe=decision == "canary")
+        self._note_tier(brk.TIER_HOST)
+        return await loop.run_in_executor(None, self._host_verify_pack, all_sets)
+
+    def _on_device_fault(self, stage: str, attempt: int, err: Exception) -> None:
+        if self._metrics:
+            self._metrics.device_faults.inc()
+        self._log.warn(
+            f"device {stage} fault (attempt {attempt + 1}): "
+            f"{type(err).__name__}: {err} — degrading"
+        )
+
+    def _device_recovered(self, probe: bool = False) -> None:
+        self._breaker.record_success(probe=probe)
+        self._publish_breaker()
+
+    def _record_breaker_failure(self, probe: bool = False) -> None:
+        """One JOB whose device dispatches all faulted = one breaker
+        failure (consecutive failed jobs trip it, not attempts);
+        ``probe`` marks the canary's own outcome (only it may drive
+        half-open transitions)."""
+        tripped = self._breaker.record_failure(probe=probe)
+        if tripped:
+            if self._metrics:
+                self._metrics.breaker_trips.inc()
+            self._log.error(
+                "device circuit breaker OPEN: routing verification to "
+                "the host verifier until a canary probe succeeds"
+            )
+        self._publish_breaker()
+
+    def _note_tier(self, tier: str) -> None:
+        """Count one job engaging a degraded tier (metrics + the
+        process-wide worst-tier record bench.py stamps into its JSON)."""
+        brk.note_tier(tier)
+        if self._metrics and tier != brk.TIER_DEVICE:
+            self._metrics.degraded_jobs.labels(tier=tier).inc()
+
+    def _publish_breaker(self) -> None:
+        state = self._breaker.state
+        if self._metrics:
+            self._metrics.breaker_state.set(brk.STATE_CODES[state])
+        brk.note_breaker(state, self._breaker.trips)
 
 
 def _make_job(sets: List[SignatureSet]) -> _BufferedJob:

@@ -87,6 +87,50 @@ class BlsPoolMetrics:
             "AOT-registered programs present + fresh at pool start",
             registry=registry,
         )
+        # Fault-domain observability (chain/bls/breaker.py + the
+        # degradation ladder in device_pool.py): a node quietly serving
+        # verdicts off the host fallback must be visible on a dashboard,
+        # not discovered in a post-mortem.
+        self.device_faults = Counter(
+            f"{ns}_device_faults_total",
+            "Device dispatch exceptions (XLA runtime/compile errors; "
+            "verification verdicts of False are NOT counted here)",
+            registry=registry,
+        )
+        self.degraded_jobs = Counter(
+            f"{ns}_degraded_jobs_total",
+            "Jobs that engaged a degradation tier beyond the batch "
+            "kernel (tier: device_retry | per_set | host)",
+            labelnames=("tier",),
+            registry=registry,
+        )
+        self.breaker_state = Gauge(
+            f"{ns}_breaker_state",
+            "Device circuit-breaker state (0 closed / 1 half-open / 2 open)",
+            registry=registry,
+        )
+        self.breaker_trips = Counter(
+            f"{ns}_breaker_trips_total",
+            "Circuit-breaker trips (closed/half-open -> open)",
+            registry=registry,
+        )
+        self.breaker_probes = Counter(
+            f"{ns}_breaker_probes_total",
+            "Half-open canary jobs admitted to the device",
+            registry=registry,
+        )
+        self.breaker_short_circuits = Counter(
+            f"{ns}_breaker_short_circuited_jobs_total",
+            "Jobs routed straight to the host verifier while the "
+            "breaker was open",
+            registry=registry,
+        )
+        self.persistent_cache_load_errors = Counter(
+            f"{ns}_persistent_cache_load_errors_total",
+            "Persistent-cache entries that existed but failed to "
+            "deserialize (quarantined + recompiled; see docs/AOT.md)",
+            registry=registry,
+        )
 
     @classmethod
     def get(cls) -> "BlsPoolMetrics":

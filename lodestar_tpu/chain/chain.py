@@ -25,7 +25,7 @@ from lodestar_tpu.state_transition.epoch.phase0 import (
 )
 from lodestar_tpu.state_transition.signature_sets import get_block_signature_sets
 from lodestar_tpu.types import ssz
-from lodestar_tpu.utils import gather_settled
+from lodestar_tpu.utils import gather_settled, get_logger
 from lodestar_tpu.utils.queue import JobItemQueue, QueueType
 from .bls import BlsVerifier, SingleThreadBlsVerifier, VerifyOptions
 from .clock import LocalClock
@@ -52,6 +52,8 @@ from lodestar_tpu.fork_choice import (
     ProtoArray,
     ProtoBlock,
 )
+
+_log = get_logger("chain")
 
 BLOCK_QUEUE_LENGTH = 256  # blocks/index.ts:17
 
@@ -453,8 +455,14 @@ class BeaconChain:
                         self.metrics.validator_monitor.on_attestation_in_block(
                             int(idx), att.data.target.epoch, dist
                         )
-            except Exception:
-                continue  # vote outside cached shufflings — skip
+            except Exception as e:
+                # vote outside cached shufflings — skip this att's
+                # monitor update, but leave a trace
+                _log.debug(
+                    f"validator-monitor attestation skipped: "
+                    f"{type(e).__name__}: {e}"
+                )
+                continue
 
         old_head_root = self.head_root
         head = self.fork_choice.update_head()
@@ -512,7 +520,13 @@ class BeaconChain:
         if base is None:
             try:
                 base = self.regen._replay_to(root)
-            except Exception:
+            except Exception as e:
+                # regen miss: None is this API's answer, but the replay
+                # failure itself must not vanish
+                _log.debug(
+                    f"checkpoint-state regen failed for "
+                    f"0x{root.hex()[:8]}: {type(e).__name__}: {e}"
+                )
                 return None
         boundary_slot = epoch * _p.SLOTS_PER_EPOCH
         if base.state.slot < boundary_slot:

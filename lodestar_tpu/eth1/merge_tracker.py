@@ -18,6 +18,10 @@ from dataclasses import dataclass
 from enum import Enum
 from typing import Dict, Optional, Protocol
 
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("merge-tracker")
+
 
 @dataclass(frozen=True)
 class PowBlock:
@@ -150,8 +154,11 @@ class Eth1MergeBlockTracker:
             ):
                 try:
                     await self.poll_once()
-                except Exception:
-                    pass
+                except Exception as e:
+                    _log.warn(
+                        f"eth1 poll failed: {type(e).__name__}: {e}; "
+                        f"retrying in {interval_s:.0f}s"
+                    )
                 await asyncio.sleep(interval_s)
 
         self._task = asyncio.create_task(_loop())
@@ -161,6 +168,8 @@ class Eth1MergeBlockTracker:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                pass  # our own cancel — the expected outcome
+            except Exception as e:
+                _log.debug(f"poll task ended with {type(e).__name__}: {e}")
             self._task = None

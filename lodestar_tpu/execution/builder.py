@@ -12,23 +12,61 @@ from __future__ import annotations
 import secrets
 from typing import Dict, Optional
 
-from lodestar_tpu.execution.http_session import ReusedClientSession
+from lodestar_tpu.execution.http_session import (
+    ReusedClientSession,
+    request_with_retry,
+)
 from lodestar_tpu.params import ForkName
+from lodestar_tpu.testing import faults
 from lodestar_tpu.types import ssz
+from lodestar_tpu.utils import get_logger
 
 
 class BuilderApiError(Exception):
-    pass
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
 
 
 class HttpBuilderApi(ReusedClientSession):
-    """builder-specs REST client (http.ts role)."""
+    """builder-specs REST client (http.ts role).
+
+    Idempotent calls (status, getHeader, validator registration — the
+    registrations overwrite by pubkey) retry transport faults and 5xx
+    with bounded backoff + jitter.  ``submit_blinded_block`` never
+    retries: revealing a payload is the point-of-no-return of the
+    blinded flow, and a request that died mid-flight may already have
+    been accepted by the relay."""
 
     def __init__(self, base_url: str, timeout: float = 12.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self._log = get_logger("builder")
 
-    async def _req(self, method: str, path: str, body: Optional[bytes] = None):
+    async def _req(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        idempotent: bool = True,
+    ):
+        async def send_once():
+            faults.fire("execution.builder.http", method=method, path=path)
+            return await self._req_once(method, path, body)
+
+        return await request_with_retry(
+            send_once,
+            idempotent=idempotent,
+            retryable_status=lambda e: (
+                isinstance(e, BuilderApiError)
+                and e.status is not None
+                and e.status >= 500
+            ),
+            log=lambda m: self._log.warn(f"{path}: {m}"),
+        )
+
+    async def _req_once(self, method: str, path: str, body: Optional[bytes]):
+        """One transport attempt (overridden by transport-free tests)."""
         import aiohttp
 
         session = await self._ses()
@@ -41,7 +79,7 @@ class HttpBuilderApi(ReusedClientSession):
         ) as resp:
             data = await resp.read()
             if resp.status >= 400:
-                raise BuilderApiError(f"{path}: HTTP {resp.status}")
+                raise BuilderApiError(f"{path}: HTTP {resp.status}", resp.status)
             return data
 
     async def check_status(self) -> None:
@@ -62,7 +100,10 @@ class HttpBuilderApi(ReusedClientSession):
     async def submit_blinded_block(self, signed_blinded_block):
         t = type(signed_blinded_block)
         data = await self._req(
-            "POST", "/eth/v1/builder/blinded_blocks", t.serialize(signed_blinded_block)
+            "POST",
+            "/eth/v1/builder/blinded_blocks",
+            t.serialize(signed_blinded_block),
+            idempotent=False,
         )
         return ssz.bellatrix.ExecutionPayload.deserialize(data)
 

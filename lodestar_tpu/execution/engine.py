@@ -14,7 +14,12 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Dict, Optional, Protocol
 
-from lodestar_tpu.execution.http_session import ReusedClientSession
+from lodestar_tpu.execution.http_session import (
+    ReusedClientSession,
+    request_with_retry,
+)
+from lodestar_tpu.testing import faults
+from lodestar_tpu.utils import get_logger
 
 
 class ExecutePayloadStatus(str, Enum):
@@ -169,17 +174,51 @@ class MockExecutionEngine:
         )
 
 
+class EngineHttpError(RuntimeError):
+    """Non-2xx HTTP response from the EL (before JSON-RPC framing)."""
+
+    def __init__(self, method: str, status: int):
+        super().__init__(f"{method}: HTTP {status}")
+        self.status = status
+
+
 class HttpExecutionEngine(ReusedClientSession):
     """engine_* JSON-RPC client (http.ts).  Supports the jwt-secret auth
-    the Engine API requires."""
+    the Engine API requires.
+
+    Transport faults (connection errors, 5xx) retry with bounded
+    exponential backoff + jitter: every engine_* method is idempotent —
+    re-submitting the same payload / forkchoice state is a no-op on the
+    EL — so a flaky EL hiccup must not fail block production outright
+    (reference engine/http.ts retries the same way).  JSON-RPC *error
+    responses* are answers, not faults: they surface immediately."""
 
     def __init__(self, url: str, jwt_secret: Optional[bytes] = None, timeout: float = 12.0):
         self.url = url
         self.jwt_secret = jwt_secret
         self.timeout = timeout
         self._id = 0
+        self._log = get_logger("engine")
 
     async def _rpc(self, method: str, params):
+        async def send_once():
+            faults.fire("execution.engine.http", method=method)
+            return await self._post_once(method, params)
+
+        body = await request_with_retry(
+            send_once,
+            idempotent=True,
+            retryable_status=lambda e: (
+                isinstance(e, EngineHttpError) and e.status >= 500
+            ),
+            log=lambda m: self._log.warn(f"{method}: {m}"),
+        )
+        if "error" in body:
+            raise RuntimeError(f"{method}: {body['error']}")
+        return body["result"]
+
+    async def _post_once(self, method: str, params) -> dict:
+        """One transport attempt (overridden by transport-free tests)."""
         import aiohttp
 
         self._id += 1
@@ -193,10 +232,19 @@ class HttpExecutionEngine(ReusedClientSession):
             headers=headers,
             timeout=aiohttp.ClientTimeout(total=self.timeout),
         ) as resp:
-            body = await resp.json()
-        if "error" in body:
-            raise RuntimeError(f"{method}: {body['error']}")
-        return body["result"]
+            if resp.status >= 500:
+                # some ELs answer internal errors with HTTP 500 + a
+                # JSON-RPC error object: that is a deterministic ANSWER
+                # — surface it (the caller raises with its message)
+                # instead of retrying it and losing the diagnostic
+                try:
+                    body = await resp.json()
+                except (aiohttp.ContentTypeError, ValueError):
+                    body = None
+                if isinstance(body, dict) and "error" in body:
+                    return body
+                raise EngineHttpError(method, resp.status)
+            return await resp.json()
 
     def _jwt_token(self) -> str:
         """HS256 JWT with iat claim (Engine API auth spec)."""

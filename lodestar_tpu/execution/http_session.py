@@ -1,4 +1,5 @@
-"""Shared aiohttp session reuse for the long-lived HTTP clients.
+"""Shared aiohttp session reuse + bounded retry for the long-lived
+HTTP clients.
 
 HttpExecutionEngine, HttpBuilderApi, and the beacon ApiClient each talk
 to a single upstream over many small requests; creating a ClientSession
@@ -17,6 +18,80 @@ be shared across owners or reused after its owner shuts down; build a
 fresh client instead.
 """
 from __future__ import annotations
+
+import asyncio
+import random
+from typing import Awaitable, Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+# Bounded: a dead upstream must fail the caller in ~a second, not hang
+# a slot's worth of duties behind open-ended retries.
+RETRY_ATTEMPTS = 3
+RETRY_BASE_DELAY_S = 0.2
+RETRY_MAX_DELAY_S = 2.0
+
+
+def _transient_transport_error(e: BaseException) -> bool:
+    """Connection-level faults: the TCP/TLS layer failed outright.
+    These are worth retrying on idempotent calls — a flaky EL restart
+    must not fail block production on the first hiccup.  TIMEOUTS are
+    deliberately NOT retried: each attempt against a hung upstream
+    burns the full client timeout (12 s default), so three attempts
+    would stretch a slot-deadlined engine call to ~3x the timeout —
+    far worse than surfacing the first one.  aiohttp's timeout errors
+    (ServerTimeoutError, ConnectionTimeoutError, ...) SUBCLASS
+    ClientConnectionError, so the timeout exclusion must be explicit."""
+    import aiohttp
+
+    if isinstance(e, (asyncio.TimeoutError, TimeoutError)):
+        return False
+    return isinstance(e, (aiohttp.ClientConnectionError, ConnectionError))
+
+
+async def request_with_retry(
+    send_once: Callable[[], Awaitable[T]],
+    *,
+    idempotent: bool = True,
+    retryable_status: Optional[Callable[[BaseException], bool]] = None,
+    attempts: int = RETRY_ATTEMPTS,
+    base_delay_s: float = RETRY_BASE_DELAY_S,
+    max_delay_s: float = RETRY_MAX_DELAY_S,
+    log: Optional[Callable[[str], None]] = None,
+) -> T:
+    """Run ``send_once`` with bounded retry, exponential backoff and
+    full jitter for transient faults.
+
+    Only **idempotent** calls retry at all: a non-idempotent request
+    that failed mid-flight may have been applied upstream, so its first
+    error surfaces unretried.  Retried error classes: connection-level
+    transport faults (see _transient_transport_error) plus whatever
+    ``retryable_status`` accepts (clients pass a predicate matching
+    their 5xx error type).  Cancellation-safe: ``CancelledError``
+    re-raises immediately — shutdown must never sit out a backoff
+    sleep.  The jittered delay (0.5-1.0x of the exponential step)
+    keeps a fleet of restarted validators from stampeding a recovering
+    EL in lockstep."""
+    for attempt in range(attempts):
+        try:
+            return await send_once()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            transient = _transient_transport_error(e) or (
+                retryable_status is not None and retryable_status(e)
+            )
+            if not (idempotent and transient) or attempt == attempts - 1:
+                raise
+            delay = min(max_delay_s, base_delay_s * (2**attempt))
+            delay *= random.uniform(0.5, 1.0)
+            if log is not None:
+                log(
+                    f"transient HTTP fault ({type(e).__name__}: {e}); "
+                    f"retry {attempt + 1}/{attempts - 1} in {delay:.2f}s"
+                )
+        await asyncio.sleep(delay)
+    raise AssertionError("unreachable")  # loop always returns or raises
 
 
 class ReusedClientSession:

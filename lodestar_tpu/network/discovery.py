@@ -110,7 +110,9 @@ def verify_enr(enr: ENR) -> bool:
     try:
         pk = api.PublicKey.from_bytes(bytes(enr.content.pubkey))
         sig = api.Signature.from_bytes(bytes(enr.signature))
-    except Exception:
+    # malformed pubkey/signature bytes are an invalid-ENR verdict
+    # (False), not a fault to surface
+    except Exception:  # lodelint: disable=silent-except
         return False
     return api.verify(pk, ENRContent.hash_tree_root(enr.content), sig)
 
@@ -495,8 +497,10 @@ class DiscoveryService:
             while self._running:
                 try:
                     await self.lookup()
-                except Exception:
-                    pass
+                except Exception as e:
+                    self.log.warn(
+                        f"discovery lookup failed: {type(e).__name__}: {e}"
+                    )
                 await asyncio.sleep(interval_s)
 
         self._task = asyncio.create_task(_loop())
@@ -507,6 +511,10 @@ class DiscoveryService:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            except asyncio.CancelledError:
+                pass  # our own cancel — the expected outcome
+            except Exception as e:
+                self.log.debug(
+                    f"discovery task ended with {type(e).__name__}: {e}"
+                )
             self._task = None

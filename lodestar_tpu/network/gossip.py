@@ -67,7 +67,10 @@ def compute_message_id(topic: str, raw_message: bytes) -> bytes:
         decompressed = snappy_decompress(raw_message)
         domain = MESSAGE_DOMAIN_VALID_SNAPPY
         payload = decompressed
-    except Exception:
+    # spec-mandated fallback: an undecompressable message gets the
+    # INVALID_SNAPPY message-id domain (p2p spec, altair message-id) —
+    # expected hostile input, not a fault
+    except Exception:  # lodelint: disable=silent-except
         domain = MESSAGE_DOMAIN_INVALID_SNAPPY
         payload = raw_message
     topic_bytes = topic.encode()

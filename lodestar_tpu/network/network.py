@@ -31,6 +31,9 @@ from .reqresp.protocols import (
 from .reqresp.reqresp import ReqRespNode
 from lodestar_tpu.types import signed_block_wire_codec
 from .transport import Endpoint, InProcessHub
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("network")
 
 
 class Network:
@@ -408,13 +411,20 @@ class Network:
                     if asyncio.iscoroutine(pid):  # async resolver: dials TCP
                         try:
                             pid = await pid
-                        except Exception:
+                        except Exception as e:
+                            _log.debug(
+                                f"peer resolve failed: "
+                                f"{type(e).__name__}: {e}"
+                            )
                             continue
                     if pid is None or pid in self.peer_manager.connected_peers():
                         continue
                     try:
                         await self.connect(pid)
-                    except Exception:
+                    except Exception as e:
+                        _log.debug(
+                            f"dial {pid} failed: {type(e).__name__}: {e}"
+                        )
                         continue
         return len(self.peer_manager.connected_peers())
 

@@ -138,8 +138,10 @@ def decode_response_chunks(ssz_type, data: bytes, context_bytes_len: int = 0):
             try:
                 msg, _ = decode_payload(ByteListT(256), data[pos:])
                 text = bytes(msg).decode(errors="replace")
-            except Exception:
-                text = ""
+            except Exception as e:
+                # the ReqRespError below is the surfaced fault; note
+                # that the peer's error text itself was undecodable
+                text = f"<undecodable error payload: {type(e).__name__}>"
             raise ReqRespError(RespStatus(status), text)
         ctx = data[pos : pos + context_bytes_len]
         pos += context_bytes_len

@@ -38,6 +38,9 @@ from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
 from . import noise
 from .gossip import compute_message_id
 from .transport import GossipHandler, RequestHandler
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("wire")
 
 # frame types
 _REQ = 0x01
@@ -107,11 +110,13 @@ class _Conn:
                 await self.transport._on_frame(self, plain)
         except asyncio.CancelledError:
             raise
-        except Exception:
+        except Exception as e:
             # includes malformed-but-authenticated frames (bad topic
             # bytes, truncated bodies): the peer is broken either way —
             # tear the connection down rather than leak task exceptions
-            pass
+            _log.debug(
+                f"recv loop ended: {type(e).__name__}: {e}; dropping conn"
+            )
         finally:
             self.transport._drop_conn(self)
 
@@ -121,8 +126,8 @@ class _Conn:
             self._recv_task.cancel()
         try:
             self.writer.close()
-        except Exception:
-            pass
+        except Exception as e:
+            _log.debug(f"writer close failed: {type(e).__name__}: {e}")
 
 
 @dataclass
@@ -182,7 +187,10 @@ class WireTransport:
             session = await asyncio.wait_for(
                 noise.responder_handshake(reader, writer, self.static_priv), 5.0
             )
-        except Exception:
+        except Exception as e:
+            _log.debug(
+                f"inbound handshake failed: {type(e).__name__}: {e}"
+            )
             writer.close()
             return
         await self._start_conn(reader, writer, session)
@@ -420,7 +428,8 @@ class WireTransport:
                 self._heartbeat_once()
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as e:
+                _log.warn(f"heartbeat failed: {type(e).__name__}: {e}")
                 continue
 
     def _heartbeat_once(self) -> None:

@@ -8,7 +8,9 @@ from __future__ import annotations
 import asyncio
 from typing import Optional
 
-from lodestar_tpu.utils import Logger
+from lodestar_tpu.utils import Logger, get_logger
+
+_log = get_logger("node")
 
 
 def format_status_line(chain, network=None, sync=None) -> str:
@@ -23,7 +25,9 @@ def format_status_line(chain, network=None, sync=None) -> str:
         head_slot = chain.fork_choice.get_block(
             "0x" + head_root.hex()
         ).slot  # proto-array node
-    except Exception:
+    # status-line decoration is best-effort: a head outside the
+    # proto-array simply renders without a head slot
+    except Exception:  # lodelint: disable=silent-except
         pass
     st = chain.fork_choice.store
 
@@ -46,7 +50,9 @@ def format_status_line(chain, network=None, sync=None) -> str:
     if network is not None:
         try:
             parts.append(f"peers: {len(network.peer_manager.connected_peers())}")
-        except Exception:
+        # status-line decoration is best-effort: a network double
+        # without peer accounting renders without the peers field
+        except Exception:  # lodelint: disable=silent-except
             pass
     return " - ".join(parts)
 
@@ -81,7 +87,13 @@ async def run_node_notifier(
             try:
                 into = chain.clock.seconds_into_slot()
                 delay = max(0.05, min(seconds_per_slot - into + 0.01, seconds_per_slot))
-            except Exception:
+            except Exception as e:
+                # a clock double without seconds_into_slot: fall back
+                # to whole-slot cadence, visibly
+                _log.debug(
+                    f"clock probe failed ({type(e).__name__}: {e}); "
+                    f"sleeping a full slot"
+                )
                 delay = seconds_per_slot
             await asyncio.sleep(delay)
     except asyncio.CancelledError:

@@ -36,6 +36,12 @@ except ImportError:  # pragma: no cover
 _CACHE: dict = {}
 
 
+def _cache_generation() -> str:
+    from lodestar_tpu.aot.cache import cache_generation
+
+    return cache_generation()
+
+
 def _env_key():
     import os
 
@@ -45,6 +51,12 @@ def _env_key():
         fp._target_platform(),
         fp._use_pallas(),
         os.environ.get("LODESTAR_TPU_CPU_PARALLEL_FP"),
+        # cache-generation salt: a generation bump must invalidate
+        # EVERY cached program artifact in the process, traced jaxprs
+        # included, so no replay straddles the old and new persistent-
+        # cache generations.  The shared helper normalizes the value
+        # exactly like the persistent-cache dir does.
+        _cache_generation(),
     )
 
 

@@ -22,6 +22,9 @@ from lodestar_tpu.state_transition.util.domain import (
 )
 from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
 from lodestar_tpu.types import ssz
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("backfill")
 
 
 class BackfillError(ValueError):
@@ -142,6 +145,10 @@ class BackfillSync:
                 blocks = await self.network.blocks_by_range(pid, start, count)
                 if blocks:
                     return blocks
-            except Exception:
+            except Exception as e:
+                _log.debug(
+                    f"blocks_by_range from {pid} failed: "
+                    f"{type(e).__name__}: {e}; trying next peer"
+                )
                 continue
         return None

@@ -25,6 +25,9 @@ from typing import Dict, List, Optional, Set
 
 from lodestar_tpu.params import ACTIVE_PRESET as _p
 from lodestar_tpu.network.peers import PeerAction
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("range-sync")
 
 EPOCHS_PER_BATCH = 1  # sync/constants.ts:41
 MAX_BATCH_DOWNLOAD_ATTEMPTS = 5  # sync/constants.ts:8
@@ -114,7 +117,13 @@ class RangeSync:
             blocks = await self.network.blocks_by_range(
                 pid, batch.start_slot, batch.count
             )
-        except Exception:
+        except Exception as e:
+            # the failure is HANDLED below (peer scored, batch retried)
+            # — this just keeps the cause visible
+            _log.debug(
+                f"batch download from {pid} failed: "
+                f"{type(e).__name__}: {e}"
+            )
             blocks = None
         if blocks is None:
             batch.failed_peers.add(pid)

@@ -5,6 +5,10 @@ from __future__ import annotations
 
 from typing import List
 
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("unknown-block")
+
 from lodestar_tpu.types import ssz
 
 MAX_ANCESTOR_DEPTH = 32
@@ -33,7 +37,11 @@ class UnknownBlockSync:
                     if got:
                         fetched = got[0]
                         break
-                except Exception:
+                except Exception as e:
+                    _log.debug(
+                        f"blocks_by_root from {pid} failed: "
+                        f"{type(e).__name__}: {e}; trying next peer"
+                    )
                     continue
             if fetched is None:
                 raise ValueError(f"cannot resolve ancestor {parent.hex()}")

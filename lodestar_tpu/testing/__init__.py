@@ -1,0 +1,8 @@
+"""Test-support code importable from production seams.
+
+Only the fault-injection harness lives here (``testing.faults``): the
+production modules call its zero-cost ``fire()`` checkpoints so chaos
+tests can arm deterministic failures without monkeypatching internals.
+Nothing in this package may import jax or heavy dependencies — a
+``fire()`` call sits on the BLS hot path.
+"""

@@ -1,0 +1,155 @@
+"""Deterministic fault injection for chaos tests (docs/FAULTS.md).
+
+Production code marks its failure seams with a named checkpoint::
+
+    from lodestar_tpu.testing import faults
+    ...
+    faults.fire("bls.device.execute")   # no-op unless a test armed it
+
+and a test arms the point with a deterministic schedule::
+
+    with faults.inject("bls.device.execute", times=2,
+                       error=lambda: XlaRuntimeError("injected")):
+        ...  # the first two fire() calls raise, later ones pass
+
+Design constraints:
+
+* **Zero cost when disarmed** — ``fire()`` is one dict check on the BLS
+  hot path; the module imports nothing heavy.
+* **Deterministic** — schedules are count/script/modulo based, never
+  random, so a chaos test's failure sequence is exactly reproducible.
+* **Thread-safe** — fire() is called from executor threads (device
+  dispatch) and the event loop alike; arming/disarming takes a lock and
+  per-plan counters are guarded by it.
+* **Scoped** — ``inject`` is a context manager that restores whatever
+  plan (usually none) was armed before, so a failing test cannot leak
+  an armed fault into the rest of the suite.
+
+The registered injection points are listed in docs/FAULTS.md; grep for
+``faults.fire`` to find the seams in code.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+class FaultError(RuntimeError):
+    """Default error raised by an armed injection point."""
+
+
+class FaultPlan:
+    """One armed point's failure schedule.
+
+    Exactly one of the schedule knobs is normally set:
+
+    * ``times=N``   — the first N fire() calls fail, the rest pass
+    * ``script=[True, False, ...]`` — per-call verdicts, pass when the
+      script is exhausted
+    * ``every=K``   — calls 0, K, 2K, ... fail (deterministic "rate")
+
+    With no knob set every call fails (fail-always).  ``error`` is a
+    zero-arg factory so each raise gets a fresh exception instance.
+    """
+
+    def __init__(
+        self,
+        point: str,
+        *,
+        times: Optional[int] = None,
+        script: Optional[Sequence[bool]] = None,
+        every: Optional[int] = None,
+        error: Optional[Callable[[], BaseException]] = None,
+    ):
+        knobs = sum(x is not None for x in (times, script, every))
+        if knobs > 1:
+            raise ValueError("set at most one of times/script/every")
+        self.point = point
+        self.times = times
+        self.script = list(script) if script is not None else None
+        self.every = every
+        self.error = error or (lambda: FaultError(f"injected fault: {point}"))
+        self.calls = 0  # total fire() checks seen
+        self.fired = 0  # checks that raised
+
+    def _should_fail(self, idx: int) -> bool:
+        if self.times is not None:
+            return idx < self.times
+        if self.script is not None:
+            return idx < len(self.script) and bool(self.script[idx])
+        if self.every is not None:
+            return self.every > 0 and idx % self.every == 0
+        return True
+
+
+_lock = threading.Lock()
+_ARMED: Dict[str, List[FaultPlan]] = {}
+
+
+def fire(point: str, **ctx) -> None:
+    """Production checkpoint: raise if a test armed ``point`` and its
+    schedule says this call fails.  ``ctx`` is accepted for seam
+    context (method names etc.) and currently unused by schedules."""
+    if not _ARMED:  # fast path: nothing armed anywhere in the process
+        return
+    # Reviewed exception: only reachable with a fault armed (tests), and
+    # guards dict/counter reads — microseconds, never held across I/O.
+    with _lock:  # lodelint: disable=transitive-blocking
+        plans = _ARMED.get(point)
+        if not plans:
+            return
+        plan = plans[-1]  # innermost inject() wins
+        idx = plan.calls
+        plan.calls += 1
+        fail = plan._should_fail(idx)
+        if fail:
+            plan.fired += 1
+            err = plan.error()
+    if fail:
+        raise err
+
+
+def is_armed(point: str) -> bool:
+    with _lock:
+        return bool(_ARMED.get(point))
+
+
+def active() -> List[str]:
+    """Names of currently armed points (bench stamps these into its
+    JSON so a fault-injected run can never pass as a clean number)."""
+    with _lock:
+        return sorted(p for p, plans in _ARMED.items() if plans)
+
+
+@contextmanager
+def inject(
+    point: str,
+    *,
+    times: Optional[int] = None,
+    script: Optional[Sequence[bool]] = None,
+    every: Optional[int] = None,
+    error: Optional[Callable[[], BaseException]] = None,
+):
+    """Arm ``point`` for the duration of the block; yields the plan so
+    tests can assert on ``plan.calls`` / ``plan.fired``.  Nested
+    injections on the same point stack — the innermost plan is the one
+    consulted until its block exits."""
+    plan = FaultPlan(point, times=times, script=script, every=every, error=error)
+    with _lock:
+        _ARMED.setdefault(point, []).append(plan)
+    try:
+        yield plan
+    finally:
+        with _lock:
+            plans = _ARMED.get(point, [])
+            if plan in plans:
+                plans.remove(plan)
+            if not plans:
+                _ARMED.pop(point, None)
+
+
+def reset() -> None:
+    """Disarm everything (test-suite safety net, not production API)."""
+    with _lock:
+        _ARMED.clear()

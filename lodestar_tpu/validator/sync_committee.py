@@ -28,6 +28,9 @@ from lodestar_tpu.state_transition.util.aggregator import (
     is_sync_committee_aggregator,
 )
 from lodestar_tpu.state_transition.util.misc import compute_epoch_at_slot
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("sync-committee-vc")
 
 
 @dataclass
@@ -86,8 +89,13 @@ class SyncCommitteeService:
         duties = []
         try:
             items = await self.api.get_sync_duties(epoch, indices)
-        except Exception:
-            items = []  # pre-altair node or route unavailable
+        except Exception as e:
+            # pre-altair node or route unavailable: no duties this epoch
+            _log.debug(
+                f"sync duties unavailable for epoch {epoch}: "
+                f"{type(e).__name__}: {e}"
+            )
+            items = []
         for item in items:
             duties.append(
                 SyncDuty(
@@ -114,8 +122,11 @@ class SyncCommitteeService:
                         for d in duties
                     ]
                 )
-            except Exception:
-                pass  # transient: retried with the next epoch's fetch
+            except Exception as e:
+                # transient: retried with the next epoch's fetch
+                _log.debug(
+                    f"sync-subnet prepare failed: {type(e).__name__}: {e}"
+                )
         return duties
 
     async def produce_messages(self, slot: int) -> int:
@@ -153,8 +164,13 @@ class SyncCommitteeService:
                     contribution = await self.api.produce_sync_committee_contribution(
                         slot, sub, head_root
                     )
-                except Exception:
-                    continue  # no messages pooled for this subcommittee
+                except Exception as e:
+                    # no messages pooled for this subcommittee (404-ish)
+                    _log.debug(
+                        f"no contribution for subnet {sub}: "
+                        f"{type(e).__name__}: {e}"
+                    )
+                    continue
                 signed_batch.append(
                     self.store.sign_contribution_and_proof(
                         d.pubkey, contribution, d.validator_index, proof

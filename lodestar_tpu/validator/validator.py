@@ -10,6 +10,9 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from lodestar_tpu.api.client import ApiClient
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("validator")
 from lodestar_tpu.params import ACTIVE_PRESET as _p
 from lodestar_tpu.ssz.json import to_json
 from lodestar_tpu.state_transition.util.aggregator import (
@@ -150,7 +153,13 @@ class Validator:
             data_root = ssz.phase0.AttestationData.hash_tree_root(data)
             try:
                 aggregate = await self.api.get_aggregate(slot, data_root)
-            except Exception:
+            except Exception as e:
+                # no matching aggregate pooled: a missed aggregation
+                # duty — keep it visible
+                _log.debug(
+                    f"get_aggregate failed at slot {slot}: "
+                    f"{type(e).__name__}: {e}"
+                )
                 continue
             aap = ssz.phase0.AggregateAndProof(
                 aggregator_index=duty.validator_index,
@@ -161,7 +170,11 @@ class Validator:
             try:
                 await self.api.submit_aggregate_and_proofs([signed])
                 submitted += 1
-            except Exception:
+            except Exception as e:
+                _log.warn(
+                    f"aggregate submit failed at slot {slot}: "
+                    f"{type(e).__name__}: {e}"
+                )
                 continue
         self.produced_aggregates += submitted
         return submitted
@@ -199,8 +212,11 @@ class Validator:
             ]
             try:
                 await self.api.prepare_beacon_committee_subnet(subs)
-            except Exception:
-                pass  # transient / route-missing: retried next duty fetch
+            except Exception as e:
+                # transient / route-missing: retried next duty fetch
+                _log.debug(
+                    f"subnet announce failed: {type(e).__name__}: {e}"
+                )
             else:
                 self._announced_duty_epochs.add(epoch)
         return duties
@@ -232,8 +248,13 @@ class Validator:
                     for vi in self.indices
                 ]
             )
-        except Exception:
-            self._prepared_epochs.discard(epoch)  # transient: retry next slot
+        except Exception as e:
+            # transient: retry next slot (fee recipients un-registered
+            # until it lands — warn, this affects proposals)
+            _log.warn(
+                f"prepare_beacon_proposer failed: {type(e).__name__}: {e}"
+            )
+            self._prepared_epochs.discard(epoch)
 
     async def run_slot(self, slot: int) -> None:
         await self.prepare_proposers_if_due(slot)

@@ -118,6 +118,7 @@ _FAST_FILES = {
     "test_dashboards.py",
     "test_db.py",
     "test_eth1.py",
+    "test_faults.py",
     "test_fork_choice.py",
     "test_gossip_scoring.py",
     "test_incremental_merkle.py",

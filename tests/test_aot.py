@@ -361,3 +361,243 @@ class TestCli:
         out = json.loads(capsys.readouterr().out)
         assert out["ok"] is False
         assert len(out["programs"]) >= 5
+
+
+# ---------------------------------------------------------------------------
+# self-healing cache (ISSUE 7 tentpole b)
+# ---------------------------------------------------------------------------
+
+
+def _entry_file(cache_dir, key):
+    paths = aot_cache.entry_paths(cache_dir, key)
+    assert paths, f"no on-disk entry for {key}"
+    return paths[0]
+
+
+class TestCacheGeneration:
+    def test_generation_salts_cache_dir(self, tmp_path, monkeypatch):
+        base = str(tmp_path / "cache")
+        monkeypatch.setenv("LODESTAR_TPU_JAX_CACHE", base)
+        monkeypatch.delenv("LODESTAR_TPU_CACHE_GENERATION", raising=False)
+        assert aot_cache.repo_cache_dir() == base
+        monkeypatch.setenv("LODESTAR_TPU_CACHE_GENERATION", "2")
+        assert aot_cache.repo_cache_dir() == os.path.join(base, "gen-2")
+        # bumping the generation never deletes the old dir's entries
+        os.makedirs(base, exist_ok=True)
+        open(os.path.join(base, "old-entry-cache"), "w").close()
+        assert aot_cache.repo_cache_dir() == os.path.join(base, "gen-2")
+        assert os.path.exists(os.path.join(base, "old-entry-cache"))
+
+    def test_generation_salts_opcache_env_key(self, monkeypatch):
+        from lodestar_tpu.ops.bls12_381 import opcache
+
+        monkeypatch.delenv("LODESTAR_TPU_CACHE_GENERATION", raising=False)
+        k1 = opcache._env_key()
+        monkeypatch.setenv("LODESTAR_TPU_CACHE_GENERATION", "2")
+        k2 = opcache._env_key()
+        assert k1 != k2
+
+
+class TestCacheSelfHeal:
+    def _warm_two(self, tmp_cache):
+        progs = [TinyProg(bucket=4, salt=1.5), TinyProg(bucket=8, salt=1.5)]
+        warm.warm_programs(
+            progs, tmp_cache, min_compile_time_secs=0.0, do_export=False,
+            log=lambda m: None,
+        )
+        manifest = warm.load_manifest(tmp_cache)
+        for p in progs:
+            keys = manifest["entries"][p.key]["cache_keys"]
+            assert keys, f"no cache key captured for {p.key}"
+            assert manifest["entries"][p.key]["entry_sha256"], (
+                "no entry hash recorded at warm time"
+            )
+        return progs, manifest
+
+    def test_corrupt_entry_check_fails_heal_quarantines_and_fixes(self, tmp_cache):
+        """Acceptance: a synthetically corrupted entry is detected,
+        quarantined with its bytes preserved, and `warm --check` fails
+        before / passes after `warm --heal` — healthy entries
+        untouched."""
+        progs, manifest = self._warm_two(tmp_cache)
+        victim, healthy = progs
+        vkey = manifest["entries"][victim.key]["cache_keys"][0]
+        hkey = manifest["entries"][healthy.key]["cache_keys"][0]
+        vpath = _entry_file(tmp_cache, vkey)
+        hpath = _entry_file(tmp_cache, hkey)
+        healthy_bytes = open(hpath, "rb").read()
+
+        # poison the victim's entry (truncate + garbage, like a killed
+        # mid-write or bit-rotted 111 MB pairing entry)
+        original = open(vpath, "rb").read()
+        corrupt = original[: len(original) // 2] + b"\xde\xad\xbe\xef"
+        with open(vpath, "wb") as fh:
+            fh.write(corrupt)
+
+        ok, rows = warm.check_programs(progs, tmp_cache)
+        assert not ok, "--check trusted a corrupt entry"
+        assert dict(rows)[victim.key] == "corrupt"
+        assert dict(rows)[healthy.key] == "warm"
+
+        report = warm.heal_programs(
+            progs, tmp_cache, min_compile_time_secs=0.0, do_export=False,
+            log=lambda m: None,
+        )
+        assert victim.key in report["healed"]
+        assert healthy.key in report["healthy"]
+        # the corrupt bytes are preserved in quarantine, never deleted
+        qfiles = aot_cache.quarantined_files(tmp_cache)
+        assert qfiles, "nothing quarantined"
+        assert any(open(q, "rb").read() == corrupt for q in qfiles), (
+            "quarantine did not preserve the corrupt bytes"
+        )
+        # healed: a fresh, loadable entry exists again under the key
+        assert aot_cache.entry_exists(tmp_cache, vkey)
+        assert open(_entry_file(tmp_cache, vkey), "rb").read() != corrupt
+        # healthy entry untouched byte-for-byte
+        assert open(hpath, "rb").read() == healthy_bytes
+        ok, rows = warm.check_programs(progs, tmp_cache)
+        assert ok, f"--check still failing after heal: {rows}"
+
+    def test_spy_load_failure_quarantines_and_recompiles(self, tmp_cache):
+        """End-to-end self-heal through the spy: an entry that EXISTS
+        but fails deserialization (injected at the cache.get seam) is
+        quarantined and transparently recompiled — jax's
+        never-rewrites-a-failed-load-key behavior can no longer wedge a
+        program (the five-round multichip failure mode)."""
+        from lodestar_tpu.testing import faults
+
+        prog = TinyProg(bucket=16, salt=7.25)
+        aot_cache.install_cache_spy()
+        prog.fn()(*prog.example_args())  # compile -> put on disk
+        keys = [
+            k for k, kind in aot_cache.observed_keys().items()
+            if k.startswith("jit_tiny_kernel-")
+        ]
+        assert keys
+        key = keys[-1]
+        path_before = _entry_file(tmp_cache, key)
+        errors_before = aot_cache.cache_stats()["load_errors"]
+        try:
+            # times=2: the spy retries a failed load once before
+            # quarantining, so a poisoned entry fails BOTH attempts
+            with faults.inject("aot.cache.get", times=2):
+                # a FRESH jit object must consult the persistent cache
+                TinyProg(bucket=16, salt=7.25).fn()(*prog.example_args())
+        finally:
+            faults.reset()
+        assert aot_cache.cache_stats()["load_errors"] == errors_before + 1
+        # the poisoned file moved to quarantine and a fresh entry was
+        # rewritten under the same key (miss -> compile -> put)
+        assert aot_cache.quarantined_files(tmp_cache)
+        assert aot_cache.entry_exists(tmp_cache, key), (
+            "failed-load key was not rewritten"
+        )
+        # and a third run loads clean (no new load errors)
+        TinyProg(bucket=16, salt=7.25).fn()(*prog.example_args())
+        assert aot_cache.cache_stats()["load_errors"] == errors_before + 1
+
+    def test_self_heal_keeps_check_honest(self, tmp_cache):
+        """An in-process self-heal (spy quarantine + recompile) must
+        re-stamp the manifest's entry hash: the healed bytes need not
+        match the warm-time fingerprint, and without the re-stamp the
+        next `warm --check` would call the healthy healed entry
+        corrupt — and `--heal` would re-pay the compile for nothing."""
+        from lodestar_tpu.testing import faults
+
+        progs, manifest = self._warm_two(tmp_cache)
+        victim = progs[0]
+        try:
+            with faults.inject("aot.cache.get", times=2):
+                # a fresh jit object consults the persistent cache; the
+                # injected load failure (both attempts — the spy
+                # retries once) triggers quarantine + recompile + put +
+                # manifest hash re-stamp
+                victim.fn()(*victim.example_args())
+        finally:
+            faults.reset()
+        assert aot_cache.quarantined_files(tmp_cache), "self-heal did not fire"
+        ok, rows = warm.check_programs(progs, tmp_cache)
+        assert ok, f"--check distrusts the self-healed entry: {rows}"
+
+    def test_transient_load_error_is_absorbed_without_quarantine(self, tmp_cache):
+        """A ONE-off load failure (flaky disk read) is retried, not
+        quarantined: evicting a healthy multi-minute entry over a
+        transient I/O hiccup would be self-inflicted damage."""
+        from lodestar_tpu.testing import faults
+
+        prog = TinyProg(bucket=32, salt=9.5)
+        aot_cache.install_cache_spy()
+        prog.fn()(*prog.example_args())  # compile -> put on disk
+        errors_before = aot_cache.cache_stats()["load_errors"]
+        q_before = len(aot_cache.quarantined_files(tmp_cache))
+        try:
+            with faults.inject("aot.cache.get", times=1):  # fails ONCE
+                TinyProg(bucket=32, salt=9.5).fn()(*prog.example_args())
+        finally:
+            faults.reset()
+        assert aot_cache.cache_stats()["load_errors"] == errors_before
+        assert len(aot_cache.quarantined_files(tmp_cache)) == q_before
+
+    def test_check_without_hashes_skips_content_reads(self, tmp_cache):
+        """The pool's startup freshness gauge uses check_hashes=False:
+        corruption is invisible to it (that is --check/--heal's job),
+        existence/freshness still is not."""
+        progs, manifest = self._warm_two(tmp_cache)
+        key = manifest["entries"][progs[0].key]["cache_keys"][0]
+        with open(_entry_file(tmp_cache, key), "ab") as fh:
+            fh.write(b"rot")
+        ok, rows = warm.check_programs(progs, tmp_cache, check_hashes=False)
+        assert ok, rows  # content rot not inspected on this path
+        ok, rows = warm.check_programs(progs, tmp_cache)
+        assert not ok and dict(rows)[progs[0].key] == "corrupt"
+
+    def test_heal_respects_budget(self, tmp_cache):
+        """--budget-s on heal mirrors warm: the first round-trip always
+        runs, the rest defer for the next invocation."""
+        progs = [TinyProg(bucket=4), TinyProg(bucket=8), TinyProg(bucket=16)]
+        report = warm.heal_programs(
+            progs, tmp_cache, budget_s=0.0, min_compile_time_secs=0.0,
+            do_export=False, log=lambda m: None,
+        )
+        done = (
+            report["healthy"] + report["healed"] + report["stale_rewarmed"]
+        )
+        assert done == ["tiny/b4"]
+        assert report["deferred"] == ["tiny/b8", "tiny/b16"]
+
+    def test_refresh_entry_hash_skips_when_warm_lock_held(self, tmp_cache):
+        """The spy's manifest re-stamp must not race a live warm run:
+        with .aot.lock held it skips instead of clobbering entries the
+        warm run is banking."""
+        import fcntl
+
+        progs, manifest = self._warm_two(tmp_cache)
+        key = manifest["entries"][progs[0].key]["cache_keys"][0]
+        lock_fh = open(os.path.join(tmp_cache, ".aot.lock"), "w")
+        try:
+            fcntl.flock(lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            assert warm.refresh_entry_hash(tmp_cache, key) is False
+        finally:
+            lock_fh.close()
+
+    def test_heal_cli_flag(self, tmp_cache, capsys):
+        from lodestar_tpu.aot.__main__ import main
+
+        # --heal on an empty cache recompiles everything it can — use
+        # --json to check the report shape without real kernels: the
+        # registry's programs would compile for minutes, so instead
+        # verify the flag parses and the lock path works by healing an
+        # EMPTY program list via a monkeypatched registry
+        import lodestar_tpu.aot.__main__ as cli_mod
+        from lodestar_tpu.aot import registry as reg_mod
+
+        orig = reg_mod.registered_programs
+        reg_mod.registered_programs = lambda scope="core": []
+        try:
+            rc = main(["warm", "--heal", "--json", "--cache-dir", tmp_cache])
+        finally:
+            reg_mod.registered_programs = orig
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert set(out) >= {"healthy", "healed", "stale_rewarmed", "quarantined"}

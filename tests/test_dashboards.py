@@ -72,3 +72,38 @@ def test_dashboard_targets_exported_series(path):
                     missing.append(f"{panel['title']}: {metric}")
     assert checked > 0, f"{path}: no metric expressions found"
     assert not missing, f"{path} targets unexported series: {missing}"
+
+
+# Fault-domain series the BLS pool dashboard must keep targeting (ISSUE
+# 7): a node degraded to the host verifier — or a tripped breaker — has
+# to be VISIBLE on the shipped board, so these panels are pinned, not
+# merely validated-if-present.
+_PINNED_BLS_FAULT_SERIES = {
+    "lodestar_tpu_bls_pool_degraded_jobs_total",
+    "lodestar_tpu_bls_pool_breaker_state",
+    "lodestar_tpu_bls_pool_breaker_trips_total",
+    "lodestar_tpu_bls_pool_device_faults_total",
+}
+
+
+def test_bls_pool_dashboard_pins_breaker_and_degradation_series():
+    path = os.path.join(_DASH_DIR, "lodestar_tpu_bls_pool.json")
+    dash = json.load(open(path))
+    targeted = set()
+    for panel in dash.get("panels", []):
+        for target in panel.get("targets", []):
+            targeted.update(_METRIC_RE.findall(target.get("expr", "")))
+    targeted_bases = {_base(n) for n in targeted}
+    missing = {
+        s for s in _PINNED_BLS_FAULT_SERIES
+        if s not in targeted and _base(s) not in targeted_bases
+    }
+    assert not missing, (
+        f"BLS pool dashboard lost its fault-domain panels: {sorted(missing)}"
+    )
+    # and the exporter really exports them (both directions pinned)
+    exported_bases = {_base(n) for n in _exported_names()}
+    unexported = {
+        s for s in _PINNED_BLS_FAULT_SERIES if _base(s) not in exported_bases
+    }
+    assert not unexported, f"pinned series not exported: {sorted(unexported)}"

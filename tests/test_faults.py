@@ -1,0 +1,695 @@
+"""Fault-domain chaos tests (ISSUE 7): the injection harness itself,
+the BLS degradation ladder + circuit breaker, and the HTTP retry
+policies — all against injected backends/transports (no real XLA
+compiles, no sockets; fast tier).
+"""
+import asyncio
+
+import pytest
+from prometheus_client import CollectorRegistry
+
+from lodestar_tpu.chain.bls import DeviceBlsVerifier, VerifyOptions
+from lodestar_tpu.chain.bls import breaker as brk
+from lodestar_tpu.chain.bls.breaker import DeviceCircuitBreaker
+from lodestar_tpu.chain.bls.metrics import BlsPoolMetrics
+from lodestar_tpu.testing import faults
+from lodestar_tpu.utils import gather_settled
+from tests.test_bls_verifier_service import FakeBackend, make_sets, run
+
+
+@pytest.fixture(autouse=True)
+def _disarm_everything():
+    brk.reset_process_record()
+    yield
+    faults.reset()
+    brk.reset_process_record()
+
+
+def cval(counter, **labels):
+    c = counter.labels(**labels) if labels else counter
+    return c._value.get()
+
+
+def make_pool(max_sets=4, breaker=None, backend=None):
+    reg = CollectorRegistry()
+    m = BlsPoolMetrics(registry=reg)
+    pool = DeviceBlsVerifier(
+        metrics=m,
+        _backend=backend if backend is not None else FakeBackend(),
+        max_sets_per_job=max_sets,
+        breaker=breaker if breaker is not None else DeviceCircuitBreaker(),
+    )
+    return pool, m
+
+
+# ---------------------------------------------------------------------------
+# the harness
+# ---------------------------------------------------------------------------
+
+
+class TestHarness:
+    def test_disarmed_fire_is_noop(self):
+        faults.fire("nothing.armed")  # must not raise
+
+    def test_times_schedule(self):
+        with faults.inject("p.times", times=2) as plan:
+            with pytest.raises(faults.FaultError):
+                faults.fire("p.times")
+            with pytest.raises(faults.FaultError):
+                faults.fire("p.times")
+            faults.fire("p.times")  # third call passes
+            assert (plan.calls, plan.fired) == (3, 2)
+        faults.fire("p.times")  # disarmed on exit
+
+    def test_script_schedule(self):
+        with faults.inject("p.script", script=[True, False, True]) as plan:
+            with pytest.raises(faults.FaultError):
+                faults.fire("p.script")
+            faults.fire("p.script")
+            with pytest.raises(faults.FaultError):
+                faults.fire("p.script")
+            faults.fire("p.script")  # script exhausted: pass
+            assert plan.fired == 2
+
+    def test_every_schedule(self):
+        fired = 0
+        with faults.inject("p.every", every=3):
+            for i in range(6):
+                try:
+                    faults.fire("p.every")
+                except faults.FaultError:
+                    fired += 1
+        assert fired == 2  # calls 0 and 3
+
+    def test_custom_error_factory(self):
+        with faults.inject("p.err", error=lambda: ValueError("boom")):
+            with pytest.raises(ValueError, match="boom"):
+                faults.fire("p.err")
+
+    def test_nesting_innermost_wins_then_restores(self):
+        with faults.inject("p.nest", times=0):  # outer: never fails
+            with faults.inject("p.nest", times=1):  # inner: fails once
+                with pytest.raises(faults.FaultError):
+                    faults.fire("p.nest")
+            faults.fire("p.nest")  # back to the outer plan: passes
+        assert not faults.is_armed("p.nest")
+
+    def test_active_lists_armed_points(self):
+        assert faults.active() == []
+        with faults.inject("a.b"), faults.inject("c.d"):
+            assert faults.active() == ["a.b", "c.d"]
+        assert faults.active() == []
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder (tentpole a)
+# ---------------------------------------------------------------------------
+
+
+class TestDegradationLadder:
+    def test_fault_on_first_dispatch_retry_serves_verdicts(self):
+        """Acceptance: a fault failing the FIRST dispatch of a
+        full-width pack — every waiter still receives its correct
+        boolean verdict (no exception), and the tier counters show the
+        ladder engaged."""
+        pool, m = make_pool(max_sets=4)
+        opts = VerifyOptions(batchable=True)
+
+        async def go():
+            with faults.inject("bls.device.execute", times=1):
+                futs = [
+                    pool.verify_signature_sets(make_sets(1), opts)
+                    for _ in range(3)
+                ]
+                futs.append(
+                    pool.verify_signature_sets(make_sets(1, valid=False), opts)
+                )
+                return await gather_settled(*futs)
+
+        assert run(go()) == [True, True, True, False]
+        assert cval(m.device_faults) == 1
+        assert cval(m.degraded_jobs, tier=brk.TIER_DEVICE_RETRY) == 1
+        # retry succeeded: per-set/host tiers never engaged for faults
+        assert cval(m.degraded_jobs, tier=brk.TIER_HOST) == 0
+        assert pool._breaker.state == brk.CLOSED
+
+    def test_both_attempts_fault_falls_to_per_set_kernel(self):
+        pool, m = make_pool(max_sets=4)
+        opts = VerifyOptions(batchable=True)
+
+        async def go():
+            with faults.inject("bls.device.execute", times=2):
+                good = pool.verify_signature_sets(make_sets(2), opts)
+                bad = pool.verify_signature_sets(
+                    make_sets(1, valid=False), opts
+                )
+                pad = pool.verify_signature_sets(make_sets(1), opts)
+                return await gather_settled(good, bad, pad)
+
+        assert run(go()) == [True, False, True]
+        assert pool._dv.each_calls, "per-set kernel tier did not engage"
+        assert cval(m.device_faults) == 2
+        assert cval(m.degraded_jobs, tier=brk.TIER_PER_SET) == 1
+        # the per-set kernel answered: the device works, streak cleared
+        assert pool._breaker.state == brk.CLOSED
+
+    def test_all_device_tiers_fault_host_serves_verdicts(self):
+        pool, m = make_pool(max_sets=4)
+        opts = VerifyOptions(batchable=True)
+
+        async def go():
+            with faults.inject("bls.device.execute", times=2), faults.inject(
+                "bls.device.each", times=1
+            ):
+                good = pool.verify_signature_sets(make_sets(3), opts)
+                bad = pool.verify_signature_sets(
+                    make_sets(1, valid=False), opts
+                )
+                return await gather_settled(good, bad)
+
+        assert run(go()) == [True, False]
+        assert cval(m.degraded_jobs, tier=brk.TIER_HOST) == 1
+        assert cval(m.device_faults) == 3  # two batch attempts + per-set
+        assert brk.process_degradation()["worst_tier"] == brk.TIER_HOST
+
+    def test_immediate_dispatch_path_also_ladders(self):
+        # non-batchable requests go through _run_job directly
+        pool, m = make_pool(max_sets=8)
+
+        async def go():
+            with faults.inject("bls.device.execute", times=1):
+                return await pool.verify_signature_sets(make_sets(3))
+
+        assert run(go()) is True
+        assert cval(m.degraded_jobs, tier=brk.TIER_DEVICE_RETRY) == 1
+
+    def test_encode_fault_settles_all_waiters_and_releases_stage(self):
+        """Satellite: an encode-stage fault is a HOST bug — it
+        propagates to every waiter in the pack (settle-all, no stranded
+        futures) and _release_encode frees the stage for the next
+        pack."""
+        pool, m = make_pool(max_sets=4)
+        opts = VerifyOptions(batchable=True)
+
+        async def go():
+            with faults.inject("bls.host.encode", times=1):
+                futs = [
+                    asyncio.ensure_future(
+                        pool.verify_signature_sets(make_sets(1), opts)
+                    )
+                    for _ in range(4)
+                ]
+                results = await asyncio.gather(*futs, return_exceptions=True)
+            assert all(
+                isinstance(r, faults.FaultError) for r in results
+            ), results
+            assert not pool._encoding, "encode stage leaked after fault"
+            # the stage is free: a new pack encodes and verifies fine
+            return await pool.verify_signature_sets(make_sets(2), opts)
+
+        assert run(go()) is True
+
+    def test_close_during_failing_job_settles_waiters(self):
+        class SlowFailingBackend(FakeBackend):
+            def execute_batch(self, enc):
+                import time as _t
+
+                _t.sleep(0.25)
+                raise RuntimeError("device wedged")
+
+        pool, m = make_pool(max_sets=4, backend=SlowFailingBackend())
+
+        async def go():
+            fut = asyncio.ensure_future(
+                pool.verify_signature_sets(
+                    make_sets(4), VerifyOptions(batchable=True)
+                )
+            )
+            await asyncio.sleep(0.05)  # job is mid-execute and will fail
+            await pool.close()
+            assert not [t for t in pool._tasks if not t.done()], (
+                "close left an unsettled job task"
+            )
+            with pytest.raises(RuntimeError):
+                await fut
+
+        run(go())
+
+    def test_run_pack_exception_settles_every_waiter(self):
+        """Satellite: when _run_pack DOES propagate an exception, every
+        buffered waiter in the pack receives it — no stranded futures."""
+        pool, _ = make_pool(max_sets=8)
+        opts = VerifyOptions(batchable=True)
+
+        async def go():
+            with faults.inject(
+                "bls.host.encode", error=lambda: RuntimeError("encode bug")
+            ):
+                futs = [
+                    asyncio.ensure_future(
+                        pool.verify_signature_sets(make_sets(1), opts)
+                    )
+                    for _ in range(5)
+                ]
+                await asyncio.sleep(0.3)  # window flush + failed job
+                assert all(f.done() for f in futs), "stranded waiters"
+                results = await asyncio.gather(*futs, return_exceptions=True)
+                assert all(isinstance(r, RuntimeError) for r in results)
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (tentpole a)
+# ---------------------------------------------------------------------------
+
+
+class TestBreakerUnit:
+    def test_lifecycle_and_backoff_doubling(self):
+        t = {"now": 0.0}
+        b = DeviceCircuitBreaker(
+            failure_threshold=2,
+            base_backoff_s=10.0,
+            max_backoff_s=40.0,
+            clock=lambda: t["now"],
+        )
+        assert b.allow_device() == "device"
+        assert b.record_failure() is False
+        assert b.record_failure() is True  # threshold hit: trips
+        assert b.state == brk.OPEN
+        assert b.allow_device() == "host"
+        t["now"] = 9.9
+        assert b.allow_device() == "host"  # still inside backoff
+        t["now"] = 10.0
+        assert b.allow_device() == "canary"  # half-open probe
+        assert b.allow_device() == "host"  # only ONE canary in flight
+        assert b.record_failure(probe=True) is True  # canary failed: re-open
+        assert b.state == brk.OPEN
+        t["now"] = 10.0 + 19.9
+        assert b.allow_device() == "host"  # backoff doubled to 20
+        t["now"] = 10.0 + 20.0
+        assert b.allow_device() == "canary"
+        b.record_success(probe=True)  # canary healthy: close + reset backoff
+        assert b.state == brk.CLOSED
+        assert b.allow_device() == "device"
+        assert b.trips == 2
+
+    def test_success_resets_consecutive_failures(self):
+        b = DeviceCircuitBreaker(failure_threshold=3)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        assert b.record_failure() is False  # streak restarted
+        assert b.state == brk.CLOSED
+
+    def test_cancelled_probe_does_not_wedge_half_open(self):
+        t = {"now": 0.0}
+        b = DeviceCircuitBreaker(
+            failure_threshold=1, base_backoff_s=5.0, clock=lambda: t["now"]
+        )
+        b.record_failure()  # trips
+        t["now"] = 5.0
+        assert b.allow_device() == "canary"
+        # the canary's job is cancelled before any outcome lands
+        b.cancel_probe()
+        # the probe slot is free again: a fresh canary is admitted
+        assert b.allow_device() == "canary"
+        b.record_success(probe=True)
+        assert b.state == brk.CLOSED
+
+    def test_stale_cancel_probe_token_cannot_free_new_canary(self):
+        """An ex-canary raising LATE (after its outcome resolved and a
+        newer canary was admitted) must not free the new canary's
+        in-flight slot — two concurrent probes would break the
+        'exactly ONE canary' invariant."""
+        t = {"now": 0.0}
+        b = DeviceCircuitBreaker(
+            failure_threshold=1, base_backoff_s=10.0, clock=lambda: t["now"]
+        )
+        b.record_failure()  # trips
+        t["now"] = 10.0
+        assert b.allow_device() == "canary"
+        stale_token = b.probe_token
+        b.record_failure(probe=True)  # canary A fails: re-open, backoff 20
+        t["now"] = 30.0
+        assert b.allow_device() == "canary"  # canary B admitted
+        # canary A's stale late exception path fires cancel_probe with
+        # its OLD token: B's slot must stay claimed
+        b.cancel_probe(stale_token)
+        assert b.allow_device() == "host"
+        # B's own token still works (e.g. B is cancelled for real)
+        b.cancel_probe(b.probe_token)
+        assert b.allow_device() == "canary"
+
+    def test_straggler_outcomes_cannot_drive_half_open(self):
+        """A pre-trip job finishing late (it took its "device" decision
+        before the breaker opened) must not re-open a half-open
+        breaker, double its backoff, or close it — only the canary's
+        own outcome (probe=True) drives half-open transitions."""
+        t = {"now": 0.0}
+        b = DeviceCircuitBreaker(
+            failure_threshold=2, base_backoff_s=10.0, clock=lambda: t["now"]
+        )
+        b.record_failure()
+        b.record_failure()  # trips
+        t["now"] = 10.0
+        assert b.allow_device() == "canary"
+        # straggler failure while the canary is in flight: no re-open,
+        # no trip inflation, canary slot stays claimed
+        assert b.record_failure() is False
+        assert b.state == brk.HALF_OPEN
+        assert b.trips == 1
+        assert b.allow_device() == "host"  # still exactly one canary
+        # straggler SUCCESS doesn't close either — the canary decides
+        b.record_success()
+        assert b.state == brk.HALF_OPEN
+        b.record_success(probe=True)
+        assert b.state == brk.CLOSED
+
+    def test_partial_fault_does_not_count_against_breaker(self):
+        """A job whose batch dispatch ANSWERED (verdict False) but whose
+        per-set split faulted is a partial fault: the device still
+        serves the steady-state kernel, so the breaker must not trip."""
+        breaker = DeviceCircuitBreaker(failure_threshold=1)
+        pool, m = make_pool(max_sets=4, breaker=breaker)
+        opts = VerifyOptions(batchable=True)
+
+        async def go():
+            with faults.inject("bls.device.each", times=1):
+                # one invalid set forces the verdict split; the split
+                # kernel faults -> host serves the verdicts
+                return await pool.verify_signature_sets(
+                    make_sets(2) + make_sets(1, valid=False), opts
+                )
+
+        assert run(go()) is False
+        assert breaker.state == brk.CLOSED, "partial fault tripped the breaker"
+        assert cval(m.degraded_jobs, tier=brk.TIER_HOST) == 1
+
+    def test_encode_fault_during_canary_releases_probe(self):
+        """A non-cancellation exception (encode-stage fault) escaping a
+        canary job must release the probe slot — otherwise the breaker
+        wedges half-open and never probes the device again."""
+        t = {"now": 0.0}
+        breaker = DeviceCircuitBreaker(
+            failure_threshold=1, base_backoff_s=5.0, clock=lambda: t["now"]
+        )
+        breaker.record_failure()  # trip
+        t["now"] = 5.0  # half-open territory
+        pool, m = make_pool(max_sets=4, breaker=breaker)
+        opts = VerifyOptions(batchable=True)
+
+        async def go():
+            with faults.inject("bls.host.encode", times=1):
+                with pytest.raises(faults.FaultError):
+                    await pool.verify_signature_sets(make_sets(2), opts)
+            # probe slot released: the next job is admitted as a fresh
+            # canary and closes the breaker
+            assert await pool.verify_signature_sets(make_sets(2), opts)
+            assert breaker.state == brk.CLOSED
+
+        run(go())
+
+    def test_open_breaker_host_packs_skip_device_lock(self):
+        """Short-circuited packs must not wait behind a wedged device
+        job: they bypass the device lock entirely."""
+        breaker = DeviceCircuitBreaker(failure_threshold=1)
+        breaker.record_failure()  # trip
+        pool, _ = make_pool(max_sets=4, breaker=breaker)
+
+        async def go():
+            # simulate a wedged in-flight device job holding the lock
+            await pool._device_lock.acquire()
+            try:
+                return await asyncio.wait_for(
+                    pool.verify_signature_sets(
+                        make_sets(2), VerifyOptions(batchable=True)
+                    ),
+                    timeout=2.0,
+                )
+            finally:
+                pool._device_lock.release()
+
+        assert run(go()) is True
+
+    def test_half_open_bystanders_skip_deferral(self):
+        """While a canary is in flight (half-open), other sub-cap packs
+        route to host — the flush deferral must not park them behind
+        the (possibly wedged) canary holding the device lock."""
+        t = {"now": 0.0}
+        breaker = DeviceCircuitBreaker(
+            failure_threshold=1, base_backoff_s=5.0, clock=lambda: t["now"]
+        )
+        breaker.record_failure()  # trip
+        t["now"] = 5.0
+        assert breaker.allow_device() == "canary"  # probe slot claimed
+        pool, _ = make_pool(max_sets=4, breaker=breaker)
+
+        async def go():
+            await pool._device_lock.acquire()  # the wedged canary
+            try:
+                return await asyncio.wait_for(
+                    pool.verify_signature_sets(
+                        make_sets(2), VerifyOptions(batchable=True)
+                    ),
+                    timeout=2.0,
+                )
+            finally:
+                pool._device_lock.release()
+
+        assert run(go()) is True
+
+    def test_open_breaker_skips_device_encode(self):
+        """While the breaker is open the pack goes to host without
+        paying the (discarded) device encode stage."""
+        breaker = DeviceCircuitBreaker(failure_threshold=1)
+        breaker.record_failure()  # trip it
+        assert breaker.state == brk.OPEN
+        pool, m = make_pool(max_sets=4, breaker=breaker)
+
+        async def go():
+            return await pool.verify_signature_sets(
+                make_sets(2), VerifyOptions(batchable=True)
+            )
+
+        assert run(go()) is True
+        assert pool._dv.encode_calls == [], "open breaker paid device encode"
+        assert pool._dv.batch_calls == []
+        assert cval(m.breaker_short_circuits) == 1
+
+
+class TestBreakerPoolLifecycle:
+    def test_trips_short_circuits_and_recovers_through_half_open(self):
+        """Acceptance: under a scripted fault schedule the breaker
+        trips, open jobs short-circuit to host (correct verdicts, no
+        device dispatch), and a canary recovers it through half-open."""
+        t = {"now": 0.0}
+        breaker = DeviceCircuitBreaker(
+            failure_threshold=2, base_backoff_s=5.0, clock=lambda: t["now"]
+        )
+        pool, m = make_pool(max_sets=4, breaker=breaker)
+        opts = VerifyOptions(batchable=True)
+
+        async def one_pack(valid=True):
+            return await pool.verify_signature_sets(make_sets(2, valid=valid), opts)
+
+        async def go():
+            with faults.inject("bls.device.execute") as ex_plan, faults.inject(
+                "bls.device.each"
+            ):
+                # jobs 1+2: every device tier faults -> host verdicts,
+                # two consecutive failed jobs -> breaker trips
+                assert await one_pack() is True
+                assert await one_pack(valid=False) is False
+                assert breaker.state == brk.OPEN
+                assert cval(m.breaker_trips) == 1
+                assert m.breaker_state._value.get() == brk.STATE_CODES[brk.OPEN]
+                # job 3: open breaker short-circuits (no device dispatch)
+                calls_before = ex_plan.calls
+                assert await one_pack() is True
+                assert ex_plan.calls == calls_before, "open breaker hit device"
+                assert cval(m.breaker_short_circuits) == 1
+                # job 4: backoff elapsed -> canary probes, still faulty ->
+                # re-opens with doubled backoff; waiters still get verdicts
+                t["now"] = 5.0
+                assert await one_pack() is True
+                assert breaker.state == brk.OPEN
+                assert cval(m.breaker_probes) == 1
+                assert cval(m.breaker_trips) == 2
+            # faults disarmed; job 5 after the doubled backoff: canary
+            # succeeds -> breaker closes, full device service resumes
+            t["now"] = 5.0 + 10.0
+            assert await one_pack() is True
+            assert breaker.state == brk.CLOSED
+            assert cval(m.breaker_probes) == 2
+            assert (
+                m.breaker_state._value.get() == brk.STATE_CODES[brk.CLOSED]
+            )
+            # and the process record kept the worst tier for bench
+            assert brk.process_degradation()["worst_tier"] == brk.TIER_HOST
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# HTTP retry (satellite: engine + builder)
+# ---------------------------------------------------------------------------
+
+
+def conn_error():
+    import aiohttp
+
+    return aiohttp.ClientConnectionError("injected: connection reset")
+
+
+class FakeEngine:
+    """Transport-free HttpExecutionEngine: _post_once is canned."""
+
+    def __new__(cls, responses):
+        from lodestar_tpu.execution.engine import HttpExecutionEngine
+
+        class _Fake(HttpExecutionEngine):
+            def __init__(self):
+                super().__init__("http://127.0.0.1:1", None)
+                self.posts = 0
+
+            async def _post_once(self, method, params):
+                self.posts += 1
+                r = responses[min(self.posts - 1, len(responses) - 1)]
+                if isinstance(r, BaseException):
+                    raise r
+                return r
+
+        return _Fake()
+
+
+class TestEngineRetry:
+    def test_connection_errors_retry_then_succeed(self):
+        eng = FakeEngine([{"result": {}}])
+
+        async def go():
+            with faults.inject(
+                "execution.engine.http", times=2, error=conn_error
+            ) as plan:
+                await eng.notify_forkchoice_update(b"\x01" * 32, b"\x01" * 32, b"\x01" * 32)
+                return plan.calls
+
+        assert run(go()) == 3  # two injected failures + one success
+
+    def test_5xx_retries_for_idempotent_call(self):
+        from lodestar_tpu.execution.engine import EngineHttpError
+
+        eng = FakeEngine(
+            [
+                EngineHttpError("engine_getPayloadV1", 503),
+                EngineHttpError("engine_getPayloadV1", 502),
+                {"result": {"ok": True}},
+            ]
+        )
+
+        async def go():
+            return await eng.get_payload(b"\x00" * 8)
+
+        assert run(go()) == {"ok": True}
+        assert eng.posts == 3
+
+    def test_retries_are_bounded(self):
+        async def go():
+            eng = FakeEngine([{"result": None}])
+            with faults.inject(
+                "execution.engine.http", error=conn_error
+            ) as plan:
+                with pytest.raises(Exception):
+                    await eng.get_payload(b"\x00" * 8)
+                return plan.calls
+
+        from lodestar_tpu.execution.http_session import RETRY_ATTEMPTS
+
+        assert run(go()) == RETRY_ATTEMPTS
+
+    def test_rpc_error_response_is_not_retried(self):
+        eng = FakeEngine([{"error": {"code": -32000, "message": "nope"}}])
+
+        async def go():
+            with pytest.raises(RuntimeError, match="nope"):
+                await eng.get_payload(b"\x00" * 8)
+
+        run(go())
+        assert eng.posts == 1
+
+    def test_cancellation_is_not_retried(self):
+        eng = FakeEngine([{"result": None}])
+
+        async def go():
+            with faults.inject(
+                "execution.engine.http",
+                error=lambda: asyncio.CancelledError(),
+            ) as plan:
+                with pytest.raises(asyncio.CancelledError):
+                    await eng.get_payload(b"\x00" * 8)
+                return plan.calls
+
+        assert run(go()) == 1  # no backoff sleep, no second attempt
+
+
+class TestBuilderRetry:
+    @staticmethod
+    def _builder(responses):
+        from lodestar_tpu.execution.builder import HttpBuilderApi
+
+        class _Fake(HttpBuilderApi):
+            def __init__(self):
+                super().__init__("http://127.0.0.1:1")
+                self.reqs = 0
+
+            async def _req_once(self, method, path, body):
+                self.reqs += 1
+                r = responses[min(self.reqs - 1, len(responses) - 1)]
+                if isinstance(r, BaseException):
+                    raise r
+                return r
+
+        return _Fake()
+
+    def test_status_5xx_retries(self):
+        from lodestar_tpu.execution.builder import BuilderApiError
+
+        b = self._builder(
+            [BuilderApiError("/status: HTTP 503", 503), b""]
+        )
+
+        async def go():
+            await b.check_status()
+
+        run(go())
+        assert b.reqs == 2
+
+    def test_4xx_is_not_retried(self):
+        from lodestar_tpu.execution.builder import BuilderApiError
+
+        b = self._builder([BuilderApiError("/status: HTTP 404", 404), b""])
+
+        async def go():
+            with pytest.raises(BuilderApiError):
+                await b.check_status()
+
+        run(go())
+        assert b.reqs == 1
+
+    def test_non_idempotent_submit_never_retries(self):
+        b = self._builder([b""])
+
+        async def go():
+            with faults.inject(
+                "execution.builder.http", error=conn_error
+            ) as plan:
+                with pytest.raises(Exception):
+                    # the raw _req path with idempotent=False is what
+                    # submit_blinded_block uses
+                    await b._req(
+                        "POST", "/eth/v1/builder/blinded_blocks", b"",
+                        idempotent=False,
+                    )
+                return plan.calls
+
+        assert run(go()) == 1, "non-idempotent call was retried"

@@ -1274,3 +1274,110 @@ def test_repo_graph_builds_and_is_nontrivial():
     assert "blocks" in project.funcs[
         "lodestar_tpu.db.controller:SqliteController.put"
     ].effects
+
+
+# ---------------------------------------------------------------------------
+# silent-except (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_silent_except_positive_merge_tracker_pre_fix():
+    # the exact pre-fix pattern: a poll loop eating every EL failure
+    src = """
+    async def loop(self):
+        while True:
+            try:
+                await self.poll_once()
+            except Exception:
+                pass
+            await asyncio.sleep(12)
+    """
+    assert [f.rule for f in lint(src, rule="silent-except")] == ["silent-except"]
+
+
+def test_silent_except_positive_return_fallback():
+    src = """
+    def probe():
+        try:
+            return compute()
+        except Exception:
+            return None
+    """
+    assert lint(src, rule="silent-except")
+
+
+def test_silent_except_negative_logged():
+    src = """
+    async def loop(self):
+        try:
+            await self.poll_once()
+        except Exception as e:
+            self._log.warn(f"poll failed: {e}")
+    """
+    assert not lint(src, rule="silent-except")
+
+
+def test_silent_except_positive_event_set_is_not_a_metric():
+    # .set() on a non-metric receiver (threading.Event) still swallows
+    src = """
+    def handle(self):
+        try:
+            work()
+        except Exception:
+            self._done_event.set()
+    """
+    assert lint(src, rule="silent-except")
+
+
+def test_silent_except_negative_metric_touch():
+    src = """
+    def handle(self):
+        try:
+            decode()
+        except Exception:
+            self.stats.invalid += 1
+            return
+    """
+    assert not lint(src, rule="silent-except")
+
+
+def test_silent_except_negative_reraise_and_bound_use():
+    src = """
+    def a():
+        try:
+            x()
+        except Exception:
+            raise RuntimeError("wrapped")
+
+    def b(fut):
+        try:
+            x()
+        except Exception as e:
+            fut.set_exception(e)
+    """
+    assert not lint(src, rule="silent-except")
+
+
+def test_silent_except_negative_narrowed_type():
+    # narrowing to the expected error type is a valid fix
+    src = """
+    def probe():
+        try:
+            import jax
+        except ImportError:
+            return None
+    """
+    assert not lint(src, rule="silent-except")
+
+
+def test_silent_except_scope_is_lodestar_tpu_only():
+    src = """
+    def probe():
+        try:
+            x()
+        except Exception:
+            return None
+    """
+    assert not lint(src, path="tests/test_mod.py", rule="silent-except")
+    assert not lint(src, path="tools/lint/mod.py", rule="silent-except")
+    assert lint(src, path="lodestar_tpu/mod.py", rule="silent-except")

@@ -207,3 +207,116 @@ class RcSignTest(Rule):
                     )
                 )
         return out
+
+
+_LOG_METHODS = {
+    "debug",
+    "info",
+    "verbose",
+    "warn",
+    "warning",
+    "error",
+    "exception",
+    "critical",
+    "log",
+}
+_METRIC_METHODS = {"inc", "dec", "observe", "set_exception"}
+# .set()/.labels() are too generic to whitelist on ANY receiver
+# (event.set() swallows a fault just fine): they only count as a
+# metric touch when the receiver chain looks metric-ish
+_AMBIGUOUS_METRIC_METHODS = {"set", "labels"}
+_METRIC_SEGMENTS = {"metrics", "stats", "m"}
+
+
+@register
+class SilentExcept(Rule):
+    id = "silent-except"
+    description = (
+        "an `except Exception` handler in lodestar_tpu/ that neither "
+        "re-raises, logs, touches a metric, nor uses the caught exception: "
+        "the fault vanishes without a trace — swallowed faults are how "
+        "degradation goes unnoticed (the BLS ladder/breaker work exists "
+        "because of exactly this class).  Handle it visibly, narrow the "
+        "exception type to the expected failure, or root-suppress with a "
+        "reviewed reason"
+    )
+
+    def applies(self, path: str) -> bool:
+        return path.startswith("lodestar_tpu/") and path.endswith(".py")
+
+    @staticmethod
+    def _catches_plain_exception(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return False  # bare except: swallowed-cancel's territory
+        types = (
+            handler.type.elts
+            if isinstance(handler.type, ast.Tuple)
+            else [handler.type]
+        )
+        return any(dotted_name(t) == "Exception" for t in types)
+
+    @staticmethod
+    def _is_log_call(call: ast.Call) -> bool:
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id == "print":
+            return True
+        dn = dotted_name(fn) or ""
+        parts = dn.split(".")
+        if parts and parts[-1] in _LOG_METHODS:
+            return True
+        # logging.getLogger(...).warning(...) — func is an Attribute on a
+        # Call, which dotted_name can't render; catch the attr directly
+        return isinstance(fn, ast.Attribute) and fn.attr in _LOG_METHODS
+
+    @staticmethod
+    def _is_metric_touch(node: ast.AST) -> bool:
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _METRIC_METHODS:
+                return True
+            if node.func.attr in _AMBIGUOUS_METRIC_METHODS:
+                dn = dotted_name(node.func.value) or ""
+                if _METRIC_SEGMENTS & set(dn.split(".")):
+                    return True
+        if isinstance(node, ast.AugAssign):
+            dn = dotted_name(node.target) or ""
+            if _METRIC_SEGMENTS & set(dn.split(".")):
+                return True
+        return False
+
+    def _handled_visibly(self, handler: ast.ExceptHandler) -> bool:
+        bound = handler.name
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) and self._is_log_call(node):
+                return True
+            if self._is_metric_touch(node):
+                return True
+            # the caught exception is captured into a result/error
+            # channel (set_exception, an errors list, a formatted
+            # message): surfaced, not silent
+            if bound and isinstance(node, ast.Name) and node.id == bound:
+                return True
+        return False
+
+    def check(self, tree, text, path) -> List[Finding]:
+        out: List[Finding] = []
+        for node in walk_tree(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not self._catches_plain_exception(handler):
+                    continue
+                if self._handled_visibly(handler):
+                    continue
+                out.append(
+                    self.finding(
+                        path,
+                        handler,
+                        "except Exception handler swallows the fault "
+                        "silently (no re-raise, no log, no metric, caught "
+                        "exception unused); make the failure visible or "
+                        "narrow the except to the expected error type",
+                    )
+                )
+        return out
