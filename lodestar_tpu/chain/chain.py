@@ -327,7 +327,22 @@ class BeaconChain:
                     )
                     if not ok:
                         raise ValueError("invalid terminal pow block")
-            res = await self.execution_engine.notify_new_payload(payload)
+            # eip4844 (engine_newPayloadV3) wants the blob versioned
+            # hashes + parent beacon block root alongside the payload
+            kwargs = {}
+            commitments = getattr(block.body, "blob_kzg_commitments", None)
+            if commitments is not None:
+                from lodestar_tpu.state_transition.block.eip4844 import (
+                    kzg_commitment_to_versioned_hash,
+                )
+
+                kwargs = dict(
+                    versioned_hashes=[
+                        kzg_commitment_to_versioned_hash(c) for c in commitments
+                    ],
+                    parent_beacon_block_root=bytes(block.parent_root),
+                )
+            res = await self.execution_engine.notify_new_payload(payload, **kwargs)
             if self.metrics and res is not None:
                 self.metrics.lodestar.engine_new_payload_total.labels(
                     status=str(getattr(res.status, "value", res.status)).lower()

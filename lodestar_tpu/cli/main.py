@@ -66,6 +66,22 @@ def build_parser() -> argparse.ArgumentParser:
     )
     beacon.add_argument("--slots", type=int, default=None,
                         help="exit after N clock slots (default: run forever)")
+    # live execution-layer seam (execution/engine.py + eth1/http_provider.py):
+    # without these flags the node keeps its default in-process behavior
+    beacon.add_argument("--execution-url", type=str, default=None,
+                        help="Engine API JSON-RPC endpoint of an execution "
+                             "client (e.g. http://127.0.0.1:8551); enables "
+                             "fork-versioned newPayload/forkchoiceUpdated/"
+                             "getPayload against a real EL")
+    beacon.add_argument("--jwt-secret-file", type=str, default=None,
+                        help="hex file holding the 32-byte Engine API JWT "
+                             "secret shared with the execution client")
+    beacon.add_argument("--eth1-url", type=str, default=None,
+                        help="eth1 JSON-RPC endpoint for deposit tracking "
+                             "(eth_getLogs over the deposit contract)")
+    beacon.add_argument("--deposit-contract", type=str, default=None,
+                        help="deposit contract address for --eth1-url log "
+                             "filtering (default: the mainnet contract)")
     # wire networking (libp2p TCP+noise+gossipsub role; network/wire.py)
     beacon.add_argument("--listen-host", type=str, default="127.0.0.1",
                         help="bind address for TCP + UDP networking")
@@ -217,6 +233,52 @@ def resolve_verifier_choice(choice: str) -> str:
     except Exception:  # lodelint: disable=silent-except
         pass
     return "oracle"
+
+
+def load_jwt_secret(path: str) -> bytes:
+    """Engine API JWT secret file: 32 bytes of hex (geth/nethermind
+    jwt.hex format, optional 0x prefix + trailing newline)."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        secret = bytes.fromhex(text.removeprefix("0x"))
+    except ValueError:
+        raise SystemExit(f"--jwt-secret-file {path}: not hex") from None
+    if len(secret) != 32:
+        raise SystemExit(
+            f"--jwt-secret-file {path}: expected 32 bytes, got {len(secret)}"
+        )
+    return secret
+
+
+def build_execution_engine(args, metrics=None):
+    """--execution-url/--jwt-secret-file → HttpExecutionEngine, or None
+    without the flag (the default in-process behavior is unchanged).
+    Separated from run_beacon so construction is testable offline."""
+    url = getattr(args, "execution_url", None)
+    if not url:
+        return None
+    from lodestar_tpu.execution.engine import HttpExecutionEngine
+
+    secret = None
+    if getattr(args, "jwt_secret_file", None):
+        secret = load_jwt_secret(args.jwt_secret_file)
+    return HttpExecutionEngine(url, jwt_secret=secret, metrics=metrics)
+
+
+def build_eth1_provider(args):
+    """--eth1-url → HttpEth1Provider feeding the deposit tracker, or
+    None without the flag."""
+    url = getattr(args, "eth1_url", None)
+    if not url:
+        return None
+    from lodestar_tpu.eth1.http_provider import (
+        MAINNET_DEPOSIT_CONTRACT,
+        HttpEth1Provider,
+    )
+
+    contract = getattr(args, "deposit_contract", None) or MAINNET_DEPOSIT_CONTRACT
+    return HttpEth1Provider(url, deposit_contract=contract)
 
 
 def run_dev(args) -> int:
@@ -382,7 +444,14 @@ def run_beacon(args) -> int:
         verifier = DeviceBlsVerifier()
 
     metrics = Metrics()
-    chain = BeaconChain(cfg, BeaconDb(), anchor, verifier=verifier, metrics=metrics)
+    # live execution seam (default None: in-process behavior unchanged);
+    # the chain owns the engine's shutdown (chain.close())
+    execution_engine = build_execution_engine(args, metrics=metrics.lodestar)
+    eth1_provider = build_eth1_provider(args)
+    chain = BeaconChain(
+        cfg, BeaconDb(), anchor, verifier=verifier, metrics=metrics,
+        execution_engine=execution_engine,
+    )
     Archiver(chain)
     lc_server = LightClientServer(chain)
     api = BeaconRestApiServer(
@@ -501,6 +570,63 @@ def run_beacon(args) -> int:
 
         maintenance_task = asyncio.ensure_future(network_maintenance())
 
+        # -- live execution seam: capability probe + eth1 deposit follow
+        engine_probe_task = None
+        if execution_engine is not None:
+            async def probe_engine():
+                """engine_exchangeCapabilities at connect (Engine API
+                handshake); a down EL must not kill the node — the
+                engine client retries per call anyway."""
+                try:
+                    caps = await execution_engine.exchange_capabilities()
+                    log.info(f"engine capabilities: {len(caps)} methods")
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    log.warn(f"engine capability probe failed: {e!r}")
+
+            engine_probe_task = asyncio.ensure_future(probe_engine())
+
+        eth1_task = None
+        if eth1_provider is not None:
+            from lodestar_tpu.eth1 import Eth1DepositDataTracker
+
+            eth1_tracker = Eth1DepositDataTracker(eth1_provider, cfg, db=chain.db)
+
+            async def eth1_follow():
+                """Deposit tracking loop (eth1DepositDataTracker.ts
+                runAutoUpdate role): pull new blocks + DepositEvent logs,
+                export sync lag + ingestion counters."""
+                poll = max(2.0, float(cfg.SECONDS_PER_ETH1_BLOCK) / 2)
+
+                def set_lag(head: int) -> None:
+                    metrics.lodestar.eth1_sync_lag_blocks.set(
+                        max(0, head - eth1_tracker._synced_to)
+                    )
+
+                while True:
+                    try:
+                        # measure lag BEFORE ingesting so a failing
+                        # update() still leaves the real (growing) lag
+                        # on the gauge — a stalled deposit sync must be
+                        # visible, not frozen at 0 (test_dashboards pin)
+                        head = await eth1_provider.get_block_number()
+                        set_lag(head)
+                        n = await eth1_tracker.update()
+                        if n:
+                            metrics.lodestar.eth1_deposit_events_total.inc(n)
+                        set_lag(head)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        log.warn(f"eth1 follow error: {e!r}")
+                    await asyncio.sleep(poll)
+
+            eth1_task = asyncio.ensure_future(eth1_follow())
+            # block production reads votes/deposits via chain.eth1
+            # (api/server.py:615 produceBlock path)
+            chain.eth1 = eth1_tracker
+
         # periodic status logline on stderr (node/notifier.ts:29)
         from lodestar_tpu.node import run_node_notifier
 
@@ -535,6 +661,12 @@ def run_beacon(args) -> int:
             notifier_task.cancel()
             maintenance_task.cancel()
             discovery_task.cancel()
+            if engine_probe_task is not None:
+                engine_probe_task.cancel()
+            if eth1_task is not None:
+                eth1_task.cancel()
+            if eth1_provider is not None:
+                await eth1_provider.close()
             await discovery.stop()
             udp.close()
             network.close()

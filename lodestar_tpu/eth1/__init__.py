@@ -134,8 +134,17 @@ class Eth1DepositDataTracker:
         if head <= self._synced_to:
             return 0
         events = await self.provider.get_deposit_events(self._synced_to + 1, head)
+        ingested = 0
         for ev in events:
+            # re-deliveries are NOT a gap: a previous update() that
+            # ingested events but failed before advancing _synced_to
+            # (e.g. an HTTP get_block fault mid-range) re-fetches the
+            # same range on retry — replaying an already-ingested index
+            # must be a no-op, or the tracker wedges on its own assert
+            if ev.index < self.tree.count():
+                continue
             assert ev.index == self.tree.count(), "deposit log gap"
+            ingested += 1
             self.tree.push(ev.deposit_data)
             self.deposit_events.append(ev)
             if self.db is not None:
@@ -144,14 +153,18 @@ class Eth1DepositDataTracker:
                     ev.index,
                     ssz.phase0.DepositData.hash_tree_root(ev.deposit_data),
                 )
-        for n in range(self._synced_to + 1, head + 1):
+        # same idempotence on retry: resume AFTER the blocks a
+        # partially-failed earlier update already cached — re-fetching
+        # them only to discard the responses wastes a round-trip each
+        last_cached = self.block_cache[-1].number if self.block_cache else -1
+        for n in range(max(self._synced_to + 1, last_cached + 1), head + 1):
             blk = await self.provider.get_block(n)
-            if blk is not None:
+            if blk is not None and blk.number > last_cached:
                 self.block_cache.append(blk)
         # single-owner: the eth1 follow task is the only writer of
         # _synced_to; the read->await->write spans only its own loop
         self._synced_to = head  # lodelint: disable=await-in-critical
-        return len(events)
+        return ingested
 
     # -- eth1 data voting (spec get_eth1_vote) --------------------------
 

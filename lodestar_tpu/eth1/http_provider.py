@@ -1,0 +1,204 @@
+"""HTTP JSON-RPC Eth1Provider (reference:
+packages/beacon-node/src/eth1/provider/eth1Provider.ts).
+
+Implements the ``Eth1Provider`` protocol the deposit tracker consumes
+over real JSON-RPC: ``eth_blockNumber`` / ``eth_getBlockByNumber`` for
+the follow head and ``eth_getLogs`` over the deposit contract for
+DepositEvent logs, fetched in bounded block-range chunks (the reference
+fetches in getLogs batches for the same reason: an unbounded mainnet
+range times out or trips provider limits).
+
+DepositEvent log ABI (the deposit contract's single event): five
+dynamic ``bytes`` arguments — pubkey(48), withdrawal_credentials(32),
+amount(8, little-endian gwei), signature(96), index(8, little-endian) —
+encoded as a standard ABI head of five offsets plus length-prefixed,
+32-byte-padded tails.  ``decode_deposit_log`` walks that layout
+strictly; a malformed log is a corrupt provider, not something to skip.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from lodestar_tpu.eth1 import Eth1Block
+from lodestar_tpu.execution.http_session import (
+    ReusedClientSession,
+    json_rpc_result,
+    post_json_rpc_once,
+    request_with_retry,
+)
+from lodestar_tpu.testing import faults
+from lodestar_tpu.utils import get_logger
+
+# keccak256("DepositEvent(bytes,bytes,bytes,bytes,bytes)") — the deposit
+# contract's only event topic (same on every network)
+DEPOSIT_EVENT_TOPIC = (
+    "0x649bbc62d0e31342afea4e5cd82d4049e7e1ee912fc0889aa790803be39038c5"
+)
+# mainnet deposit contract (config DEPOSIT_CONTRACT_ADDRESS default)
+MAINNET_DEPOSIT_CONTRACT = "0x00000000219ab540356cbb839cbe05303d7705fa"
+
+
+class Eth1HttpError(RuntimeError):
+    """Non-2xx HTTP response from the eth1 node (5xx retries)."""
+
+    def __init__(self, method: str, status: int):
+        super().__init__(f"{method}: HTTP {status}")
+        self.status = status
+
+
+class Eth1RpcError(RuntimeError):
+    """JSON-RPC error response: a deterministic answer, never retried."""
+
+    def __init__(self, method: str, code: int, message: str):
+        super().__init__(f"{method}: JSON-RPC error {code}: {message}")
+        self.method = method
+        self.code = code
+        self.message = message
+
+
+def _abi_encode_bytes_tuple(values) -> bytes:
+    """ABI-encode a tuple of dynamic `bytes` values (the DepositEvent
+    data layout) — shared with the mock EL server so both sides of the
+    seam speak the byte-exact contract encoding."""
+    head = b""
+    tail = b""
+    offset = 32 * len(values)
+    for v in values:
+        head += offset.to_bytes(32, "big")
+        padded = bytes(v) + b"\x00" * ((32 - len(v) % 32) % 32)
+        tail += len(v).to_bytes(32, "big") + padded
+        offset += 32 + len(padded)
+    return head + tail
+
+
+def _abi_decode_bytes_tuple(data: bytes, n: int) -> List[bytes]:
+    if len(data) < 32 * n:
+        raise ValueError(f"ABI data too short for {n}-bytes head: {len(data)}")
+    out = []
+    for i in range(n):
+        offset = int.from_bytes(data[32 * i : 32 * (i + 1)], "big")
+        if offset + 32 > len(data):
+            raise ValueError(f"ABI offset {offset} out of range")
+        length = int.from_bytes(data[offset : offset + 32], "big")
+        start = offset + 32
+        if start + length > len(data):
+            raise ValueError(f"ABI tail [{start}:{start+length}] out of range")
+        out.append(data[start : start + length])
+    return out
+
+
+def decode_deposit_log(log: dict):
+    """One eth_getLogs entry → ssz.phase0.DepositEvent."""
+    from lodestar_tpu.types import ssz
+
+    data = bytes.fromhex(log["data"].removeprefix("0x"))
+    pubkey, wc, amount, signature, index = _abi_decode_bytes_tuple(data, 5)
+    if (len(pubkey), len(wc), len(amount), len(signature), len(index)) != (
+        48, 32, 8, 96, 8,
+    ):
+        raise ValueError(
+            "DepositEvent field widths wrong: "
+            f"{[len(x) for x in (pubkey, wc, amount, signature, index)]}"
+        )
+    return ssz.phase0.DepositEvent(
+        deposit_data=ssz.phase0.DepositData(
+            pubkey=pubkey,
+            withdrawal_credentials=wc,
+            amount=int.from_bytes(amount, "little"),
+            signature=signature,
+        ),
+        block_number=int(log["blockNumber"], 16),
+        index=int.from_bytes(index, "little"),
+    )
+
+
+class HttpEth1Provider(ReusedClientSession):
+    """The production Eth1Provider: JSON-RPC over aiohttp with the same
+    bounded-retry discipline as the engine client (transport faults and
+    5xx retry on these read-only — hence idempotent — methods; JSON-RPC
+    errors surface immediately as ``Eth1RpcError``)."""
+
+    def __init__(
+        self,
+        url: str,
+        deposit_contract: str = MAINNET_DEPOSIT_CONTRACT,
+        timeout: float = 12.0,
+        log_chunk_size: int = 1000,
+    ):
+        self.url = url
+        self.deposit_contract = deposit_contract.lower()
+        self.timeout = timeout
+        self.log_chunk_size = max(1, int(log_chunk_size))
+        self._id = 0
+        self._log = get_logger("eth1")
+
+    async def _rpc(self, method: str, params):
+        async def send_once():
+            faults.fire("eth1.provider.http", method=method)
+            return await self._post_once(method, params)
+
+        body = await request_with_retry(
+            send_once,
+            idempotent=True,
+            retryable_status=lambda e: (
+                isinstance(e, Eth1HttpError) and e.status >= 500
+            ),
+            log=lambda m: self._log.warn(f"{method}: {m}"),
+        )
+        return json_rpc_result(
+            body, on_error=lambda code, msg: Eth1RpcError(method, code, msg)
+        )
+
+    async def _post_once(self, method: str, params) -> dict:
+        """One transport attempt (overridden by transport-free tests);
+        status/error-body semantics live in post_json_rpc_once."""
+        self._id += 1
+        session = await self._ses()
+        return await post_json_rpc_once(
+            session,
+            self.url,
+            method=method,
+            params=params,
+            rpc_id=self._id,
+            timeout_s=self.timeout,
+            http_error=Eth1HttpError,
+        )
+
+    # -- Eth1Provider protocol ------------------------------------------
+
+    async def get_block_number(self) -> int:
+        return int(await self._rpc("eth_blockNumber", []), 16)
+
+    async def get_block(self, number: int) -> Optional[Eth1Block]:
+        blk = await self._rpc("eth_getBlockByNumber", [hex(int(number)), False])
+        if blk is None:
+            return None
+        return Eth1Block(
+            number=int(blk["number"], 16),
+            hash=bytes.fromhex(blk["hash"].removeprefix("0x")),
+            timestamp=int(blk["timestamp"], 16),
+        )
+
+    async def get_deposit_events(self, from_block: int, to_block: int):
+        """DepositEvent logs for [from_block, to_block], fetched in
+        ``log_chunk_size`` ranges and returned sorted by deposit index
+        (the tracker asserts the index sequence is gap-free)."""
+        events = []
+        start = int(from_block)
+        while start <= to_block:
+            end = min(start + self.log_chunk_size - 1, int(to_block))
+            logs = await self._rpc(
+                "eth_getLogs",
+                [
+                    {
+                        "fromBlock": hex(start),
+                        "toBlock": hex(end),
+                        "address": self.deposit_contract,
+                        "topics": [DEPOSIT_EVENT_TOPIC],
+                    }
+                ],
+            )
+            events.extend(decode_deposit_log(log) for log in logs)
+            start = end + 1
+        events.sort(key=lambda ev: ev.index)
+        return events

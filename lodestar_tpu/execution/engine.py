@@ -16,6 +16,8 @@ from typing import Dict, Optional, Protocol
 
 from lodestar_tpu.execution.http_session import (
     ReusedClientSession,
+    json_rpc_result,
+    post_json_rpc_once,
     request_with_retry,
 )
 from lodestar_tpu.testing import faults
@@ -37,7 +39,9 @@ class PayloadStatus:
 
 
 class ExecutionEngine(Protocol):
-    async def notify_new_payload(self, payload) -> PayloadStatus: ...
+    async def notify_new_payload(
+        self, payload, versioned_hashes=None, parent_beacon_block_root=None
+    ) -> PayloadStatus: ...
     async def notify_forkchoice_update(
         self, head_block_hash: bytes, safe_block_hash: bytes,
         finalized_block_hash: bytes, payload_attributes=None,
@@ -128,7 +132,9 @@ class MockExecutionEngine:
         self._payloads: Dict[bytes, object] = {}
         self.notified_payloads = 0
 
-    async def notify_new_payload(self, payload) -> PayloadStatus:
+    async def notify_new_payload(
+        self, payload, versioned_hashes=None, parent_beacon_block_root=None
+    ) -> PayloadStatus:
         return self.notify_new_payload_sync_status(payload)
 
     def notify_new_payload_sync_status(self, payload) -> PayloadStatus:
@@ -175,76 +181,124 @@ class MockExecutionEngine:
 
 
 class EngineHttpError(RuntimeError):
-    """Non-2xx HTTP response from the EL (before JSON-RPC framing)."""
+    """Non-2xx HTTP response from the EL (before JSON-RPC framing).
+    401 means JWT auth failed — deterministic, never retried."""
 
     def __init__(self, method: str, status: int):
         super().__init__(f"{method}: HTTP {status}")
         self.status = status
 
 
+class EngineRpcError(RuntimeError):
+    """A JSON-RPC *error response* from the EL: a deterministic answer
+    carrying the EL's diagnostic (code + message), never retried."""
+
+    def __init__(self, method: str, code: int, message: str):
+        super().__init__(f"{method}: JSON-RPC error {code}: {message}")
+        self.method = method
+        self.code = code
+        self.message = message
+
+
+# engine_* methods this client can issue (engine_exchangeCapabilities
+# payload; the exchange method itself is excluded per the Engine API spec)
+SUPPORTED_ENGINE_METHODS = tuple(
+    f"engine_{stem}V{v}"
+    for stem in ("newPayload", "forkchoiceUpdated", "getPayload")
+    for v in (1, 2, 3)
+)
+
+
 class HttpExecutionEngine(ReusedClientSession):
     """engine_* JSON-RPC client (http.ts).  Supports the jwt-secret auth
-    the Engine API requires.
+    the Engine API requires and selects the engine structure version by
+    fork (http.ts:158-161,321): bellatrix→V1, capella→V2 (withdrawals),
+    eip4844→V3 (excessDataGas + blob versioned hashes).
 
     Transport faults (connection errors, 5xx) retry with bounded
     exponential backoff + jitter: every engine_* method is idempotent —
     re-submitting the same payload / forkchoice state is a no-op on the
     EL — so a flaky EL hiccup must not fail block production outright
     (reference engine/http.ts retries the same way).  JSON-RPC *error
-    responses* are answers, not faults: they surface immediately."""
+    responses* are answers, not faults: they surface immediately as
+    typed ``EngineRpcError``; HTTP 401 (bad/stale JWT) surfaces as
+    ``EngineHttpError`` unretried."""
 
-    def __init__(self, url: str, jwt_secret: Optional[bytes] = None, timeout: float = 12.0):
+    def __init__(
+        self,
+        url: str,
+        jwt_secret: Optional[bytes] = None,
+        timeout: float = 12.0,
+        metrics=None,
+    ):
         self.url = url
         self.jwt_secret = jwt_secret
         self.timeout = timeout
+        self.metrics = metrics  # LodestarMetrics or None
+        self.capabilities: Optional[list] = None
         self._id = 0
+        # payloadId → fork promised by the forkchoiceUpdated that minted
+        # it, so get_payload can parse the response without re-asking
+        self._payload_forks: Dict[bytes, object] = {}
         self._log = get_logger("engine")
 
     async def _rpc(self, method: str, params):
+        import time as _time
+
         async def send_once():
             faults.fire("execution.engine.http", method=method)
             return await self._post_once(method, params)
 
-        body = await request_with_retry(
-            send_once,
-            idempotent=True,
-            retryable_status=lambda e: (
-                isinstance(e, EngineHttpError) and e.status >= 500
-            ),
-            log=lambda m: self._log.warn(f"{method}: {m}"),
-        )
-        if "error" in body:
-            raise RuntimeError(f"{method}: {body['error']}")
-        return body["result"]
+        t0 = _time.perf_counter()
+        try:
+            body = await request_with_retry(
+                send_once,
+                idempotent=True,
+                retryable_status=lambda e: (
+                    isinstance(e, EngineHttpError) and e.status >= 500
+                ),
+                log=lambda m: self._log.warn(f"{method}: {m}"),
+            )
+        except Exception as e:
+            self._count_error(method, e)
+            raise
+
+        def rpc_error(code, message):
+            self._count_error(method, None, kind="rpc_error")
+            return EngineRpcError(method, code, message)
+
+        result = json_rpc_result(body, on_error=rpc_error)
+        if self.metrics is not None:
+            self.metrics.engine_rpc_seconds.labels(method=method).observe(
+                _time.perf_counter() - t0
+            )
+        return result
+
+    def _count_error(self, method: str, e, kind: Optional[str] = None) -> None:
+        if self.metrics is None:
+            return
+        if kind is None:
+            kind = "http" if isinstance(e, EngineHttpError) else "transport"
+        self.metrics.engine_rpc_errors_total.labels(method=method, kind=kind).inc()
 
     async def _post_once(self, method: str, params) -> dict:
-        """One transport attempt (overridden by transport-free tests)."""
-        import aiohttp
-
+        """One transport attempt (overridden by transport-free tests);
+        status/error-body semantics live in post_json_rpc_once."""
         self._id += 1
         headers = {}
         if self.jwt_secret is not None:
             headers["Authorization"] = f"Bearer {self._jwt_token()}"
         session = await self._ses()
-        async with session.post(
+        return await post_json_rpc_once(
+            session,
             self.url,
-            json={"jsonrpc": "2.0", "id": self._id, "method": method, "params": params},
+            method=method,
+            params=params,
+            rpc_id=self._id,
             headers=headers,
-            timeout=aiohttp.ClientTimeout(total=self.timeout),
-        ) as resp:
-            if resp.status >= 500:
-                # some ELs answer internal errors with HTTP 500 + a
-                # JSON-RPC error object: that is a deterministic ANSWER
-                # — surface it (the caller raises with its message)
-                # instead of retrying it and losing the diagnostic
-                try:
-                    body = await resp.json()
-                except (aiohttp.ContentTypeError, ValueError):
-                    body = None
-                if isinstance(body, dict) and "error" in body:
-                    return body
-                raise EngineHttpError(method, resp.status)
-            return await resp.json()
+            timeout_s=self.timeout,
+            http_error=EngineHttpError,
+        )
 
     def _jwt_token(self) -> str:
         """HS256 JWT with iat claim (Engine API auth spec)."""
@@ -263,8 +317,47 @@ class HttpExecutionEngine(ReusedClientSession):
         sig = b64(hmac.new(self.jwt_secret, msg, hashlib.sha256).digest())
         return f"{header}.{payload}.{sig}"
 
-    async def notify_new_payload(self, payload) -> PayloadStatus:
-        result = await self._rpc("engine_newPayloadV1", [payload])
+    async def exchange_capabilities(self) -> list:
+        """engine_exchangeCapabilities probe (connect-time handshake):
+        sends our method list, remembers the EL's, and warns about any
+        method we may need that the EL does not announce."""
+        result = await self._rpc(
+            "engine_exchangeCapabilities", [list(SUPPORTED_ENGINE_METHODS)]
+        )
+        self.capabilities = list(result or [])
+        missing = [
+            m for m in SUPPORTED_ENGINE_METHODS if m not in self.capabilities
+        ]
+        if missing:
+            self._log.warn(
+                f"EL does not announce {len(missing)} engine method(s): "
+                + ", ".join(missing)
+            )
+        return self.capabilities
+
+    async def notify_new_payload(
+        self, payload, versioned_hashes=None, parent_beacon_block_root=None
+    ) -> PayloadStatus:
+        """engine_newPayloadV{1,2,3} selected by the payload's own fork;
+        V3 carries blob versioned hashes + parent beacon block root
+        (computed by the caller from the block body)."""
+        from lodestar_tpu.execution import serde
+
+        fork = serde.fork_of_payload(payload)
+        version = serde.engine_version_for_fork(fork)
+        params = [serde.payload_to_json(payload)]
+        if version >= 3:
+            # an empty hash list is a legitimate no-blob block, but the
+            # parent root has no sane default — a zero root would make
+            # the EL validate against the wrong parent with no
+            # client-side hint that the caller forgot it
+            if parent_beacon_block_root is None:
+                raise serde.EngineSerdeError(
+                    "engine_newPayloadV3 requires parent_beacon_block_root"
+                )
+            params.append([serde.data(h) for h in (versioned_hashes or ())])
+            params.append(serde.data(parent_beacon_block_root))
+        result = await self._rpc(f"engine_newPayloadV{version}", params)
         return PayloadStatus(
             ExecutePayloadStatus(result["status"]),
             bytes.fromhex(result["latestValidHash"][2:]) if result.get("latestValidHash") else None,
@@ -273,18 +366,58 @@ class HttpExecutionEngine(ReusedClientSession):
 
     async def notify_forkchoice_update(
         self, head_block_hash, safe_block_hash, finalized_block_hash,
-        payload_attributes=None,
+        payload_attributes=None, fork=None,
     ) -> Optional[bytes]:
+        """engine_forkchoiceUpdatedV{1,2,3} selected by ``fork`` (or the
+        fork tagged inside ``payload_attributes``; bellatrix default)."""
+        from lodestar_tpu.execution import serde
+        from lodestar_tpu.params import ForkName
+
+        if fork is None and payload_attributes is not None:
+            fork = payload_attributes.get("fork")
+        fork = ForkName(fork) if fork is not None else ForkName.bellatrix
+        version = serde.engine_version_for_fork(fork)
         fc_state = {
             "headBlockHash": "0x" + head_block_hash.hex(),
             "safeBlockHash": "0x" + safe_block_hash.hex(),
             "finalizedBlockHash": "0x" + finalized_block_hash.hex(),
         }
+        attrs_json = (
+            serde.payload_attributes_to_json(payload_attributes, version)
+            if payload_attributes is not None
+            else None
+        )
         result = await self._rpc(
-            "engine_forkchoiceUpdatedV1", [fc_state, payload_attributes]
+            f"engine_forkchoiceUpdatedV{version}", [fc_state, attrs_json]
         )
         pid = result.get("payloadId")
-        return bytes.fromhex(pid[2:]) if pid else None
+        if not pid:
+            return None
+        pid_bytes = bytes.fromhex(pid[2:])
+        self._payload_forks[pid_bytes] = fork
+        # bounded: ids minted but never fetched (reorg past the slot,
+        # missed proposal window) must not accumulate for a node's
+        # lifetime; oldest-first eviction, one live id per slot in
+        # practice
+        while len(self._payload_forks) > 64:
+            self._payload_forks.pop(next(iter(self._payload_forks)))
+        return pid_bytes
 
-    async def get_payload(self, payload_id: bytes):
-        return await self._rpc("engine_getPayloadV1", ["0x" + payload_id.hex()])
+    async def get_payload(self, payload_id: bytes, fork=None):
+        """engine_getPayloadV{1,2,3} → the fork's SSZ ExecutionPayload.
+        The fork defaults to whatever the forkchoiceUpdated that minted
+        this payloadId promised."""
+        from lodestar_tpu.execution import serde
+        from lodestar_tpu.params import ForkName
+
+        if fork is None:
+            fork = self._payload_forks.get(bytes(payload_id), ForkName.bellatrix)
+        fork = ForkName(fork)
+        version = serde.engine_version_for_fork(fork)
+        result = await self._rpc(
+            f"engine_getPayloadV{version}", ["0x" + bytes(payload_id).hex()]
+        )
+        self._payload_forks.pop(bytes(payload_id), None)
+        # V1 answers the payload body directly; V2+ wrap it with blockValue
+        body = result if version == 1 else result["executionPayload"]
+        return serde.payload_from_json(fork, body)

@@ -94,6 +94,62 @@ async def request_with_retry(
     raise AssertionError("unreachable")  # loop always returns or raises
 
 
+async def post_json_rpc_once(
+    session,
+    url: str,
+    *,
+    method: str,
+    params,
+    rpc_id: int,
+    headers: Optional[dict] = None,
+    timeout_s: float,
+    http_error,
+):
+    """One JSON-RPC POST attempt with the error semantics every JSON-RPC
+    client in this repo shares (engine + eth1 — one implementation so a
+    semantics fix can never land on one seam and drift on the other):
+
+    * HTTP 401 → ``http_error(method, 401)`` — an auth verdict,
+      deterministic, never retried;
+    * any other 4xx/5xx carrying a JSON-RPC error object (geth answers
+      bad params with HTTP 400 + error body, internal errors with 500 +
+      error body) → the body is RETURNED — it is a deterministic ANSWER
+      whose diagnostic the caller surfaces as its typed RPC error;
+    * bodyless non-2xx → ``http_error(method, status)`` (callers retry
+      only >= 500 via their ``retryable_status`` predicate);
+    * 2xx → parsed JSON body.
+    """
+    import aiohttp
+
+    async with session.post(
+        url,
+        json={"jsonrpc": "2.0", "id": rpc_id, "method": method, "params": params},
+        headers=headers or {},
+        timeout=aiohttp.ClientTimeout(total=timeout_s),
+    ) as resp:
+        if resp.status == 401:
+            raise http_error(method, 401)
+        if resp.status >= 400:
+            try:
+                body = await resp.json()
+            except (aiohttp.ContentTypeError, ValueError):
+                body = None
+            if isinstance(body, dict) and "error" in body:
+                return body
+            raise http_error(method, resp.status)
+        return await resp.json()
+
+
+def json_rpc_result(body: dict, *, on_error):
+    """JSON-RPC response body → result, raising ``on_error(code,
+    message)`` (the client's typed RPC-error factory) on an error
+    object."""
+    if "error" in body:
+        err = body["error"] or {}
+        raise on_error(int(err.get("code", 0)), str(err.get("message", "")))
+    return body["result"]
+
+
 class ReusedClientSession:
     """Per-instance aiohttp.ClientSession, created on first use and
     reused across requests; ``close()`` releases it (idempotent) and

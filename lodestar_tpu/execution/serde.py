@@ -1,0 +1,264 @@
+"""ExecutionPayload <-> engine JSON-RPC wire encoding (reference:
+packages/beacon-node/src/execution/engine/types.ts
+serializeExecutionPayload / parseExecutionPayload).
+
+The Engine API does NOT use the Beacon-API JSON dialect (ssz/json.py):
+field names are camelCase, integers are QUANTITY (`0x`-hex, no leading
+zeros, `0x0` for zero) and byte strings are DATA (`0x`-hex, fixed
+width).  Fork coverage follows the payload's own shape: withdrawals
+from capella (V2), `excessDataGas` for eip4844 (V3).
+
+Everything here is pure data transformation shared by both sides of the
+HTTP seam: `HttpExecutionEngine` (client) and the mock EL server
+(`lodestar_tpu/testing/mock_el_server.py`).  Strictness lives in
+``payload_from_json``: a payload for fork F must carry exactly fork F's
+fields — a V2 body without withdrawals, or a V1 body with them, is an
+encoding bug worth failing loudly on, not papering over.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from lodestar_tpu.params import ForkName
+
+# engine structure version by fork (engine/http.ts:158-161: forkName →
+# newPayload/forkchoiceUpdated/getPayload V1/V2/V3)
+ENGINE_VERSION_BY_FORK: Dict[ForkName, int] = {
+    ForkName.bellatrix: 1,
+    ForkName.capella: 2,
+    ForkName.eip4844: 3,
+}
+FORK_BY_ENGINE_VERSION: Dict[int, ForkName] = {
+    v: f for f, v in ENGINE_VERSION_BY_FORK.items()
+}
+
+
+class EngineSerdeError(ValueError):
+    """Malformed engine JSON (wrong width, missing/extra fork fields)."""
+
+
+def engine_version_for_fork(fork: ForkName) -> int:
+    try:
+        return ENGINE_VERSION_BY_FORK[ForkName(fork)]
+    except KeyError:
+        raise EngineSerdeError(
+            f"fork {fork!r} has no Engine API structure version "
+            f"(pre-merge forks never reach an EL)"
+        ) from None
+
+
+def fork_of_payload(payload) -> ForkName:
+    """The fork an ExecutionPayload instance belongs to, from its SSZ
+    module (lodestar_tpu.types.<fork>.ExecutionPayload)."""
+    mod = type(payload).__module__.rsplit(".", 1)[-1]
+    try:
+        return ForkName(mod)
+    except ValueError:
+        raise EngineSerdeError(
+            f"{type(payload)!r} is not a fork ExecutionPayload"
+        ) from None
+
+
+# -- scalar encodings -------------------------------------------------------
+
+
+def quantity(value: int) -> str:
+    """QUANTITY: 0x-hex, no leading zeros ("0x0" for zero)."""
+    return hex(int(value))
+
+
+def parse_quantity(s) -> int:
+    if not isinstance(s, str) or not s.startswith("0x"):
+        raise EngineSerdeError(f"QUANTITY must be 0x-hex, got {s!r}")
+    return int(s, 16)
+
+
+def data(value: bytes) -> str:
+    """DATA: 0x-hex of the raw bytes."""
+    return "0x" + bytes(value).hex()
+
+
+def parse_data(s, length: Optional[int] = None) -> bytes:
+    if not isinstance(s, str) or not s.startswith("0x"):
+        raise EngineSerdeError(f"DATA must be 0x-hex, got {s!r}")
+    try:
+        b = bytes.fromhex(s[2:])
+    except ValueError:
+        raise EngineSerdeError(f"DATA is not hex: {s!r}") from None
+    if length is not None and len(b) != length:
+        raise EngineSerdeError(f"DATA expected {length} bytes, got {len(b)}")
+    return b
+
+
+# -- withdrawals (capella, V2+) ---------------------------------------------
+
+
+def withdrawal_to_json(w) -> dict:
+    return {
+        "index": quantity(w.index),
+        "validatorIndex": quantity(w.validator_index),
+        "address": data(w.address),
+        "amount": quantity(w.amount),
+    }
+
+
+def withdrawal_from_json(obj: dict):
+    from lodestar_tpu.types import ssz
+
+    return ssz.capella.Withdrawal(
+        index=parse_quantity(obj["index"]),
+        validator_index=parse_quantity(obj["validatorIndex"]),
+        address=parse_data(obj["address"], 20),
+        amount=parse_quantity(obj["amount"]),
+    )
+
+
+# -- ExecutionPayload -------------------------------------------------------
+
+
+def payload_to_json(payload) -> dict:
+    """SSZ ExecutionPayload (any fork) → engine JSON body; the emitted
+    fields follow the payload's own fork shape."""
+    obj = {
+        "parentHash": data(payload.parent_hash),
+        "feeRecipient": data(payload.fee_recipient),
+        "stateRoot": data(payload.state_root),
+        "receiptsRoot": data(payload.receipts_root),
+        "logsBloom": data(payload.logs_bloom),
+        "prevRandao": data(payload.prev_randao),
+        "blockNumber": quantity(payload.block_number),
+        "gasLimit": quantity(payload.gas_limit),
+        "gasUsed": quantity(payload.gas_used),
+        "timestamp": quantity(payload.timestamp),
+        "extraData": data(payload.extra_data),
+        "baseFeePerGas": quantity(payload.base_fee_per_gas),
+        "blockHash": data(payload.block_hash),
+        "transactions": [data(tx) for tx in payload.transactions],
+    }
+    if hasattr(payload, "withdrawals"):  # capella+
+        obj["withdrawals"] = [withdrawal_to_json(w) for w in payload.withdrawals]
+    if hasattr(payload, "excess_data_gas"):  # eip4844
+        obj["excessDataGas"] = quantity(payload.excess_data_gas)
+    return obj
+
+
+def payload_from_json(fork: ForkName, obj: dict):
+    """Engine JSON body → fork's SSZ ExecutionPayload, strict about the
+    fork's field set (a mismatched shape means client and EL disagree
+    about the fork — the most dangerous failure to swallow)."""
+    from lodestar_tpu.types import ssz
+
+    fork = ForkName(fork)
+    if not isinstance(obj, dict):
+        raise EngineSerdeError(f"payload body must be an object, got {type(obj)}")
+    mod = getattr(ssz, fork.value, None)
+    cls = getattr(mod, "ExecutionPayload", None)
+    if cls is None:
+        raise EngineSerdeError(f"fork {fork.value} has no ExecutionPayload")
+    try:
+        kwargs = dict(
+            parent_hash=parse_data(obj["parentHash"], 32),
+            fee_recipient=parse_data(obj["feeRecipient"], 20),
+            state_root=parse_data(obj["stateRoot"], 32),
+            receipts_root=parse_data(obj["receiptsRoot"], 32),
+            logs_bloom=parse_data(obj["logsBloom"], 256),
+            prev_randao=parse_data(obj["prevRandao"], 32),
+            block_number=parse_quantity(obj["blockNumber"]),
+            gas_limit=parse_quantity(obj["gasLimit"]),
+            gas_used=parse_quantity(obj["gasUsed"]),
+            timestamp=parse_quantity(obj["timestamp"]),
+            extra_data=parse_data(obj["extraData"]),
+            base_fee_per_gas=parse_quantity(obj["baseFeePerGas"]),
+            block_hash=parse_data(obj["blockHash"], 32),
+            transactions=[parse_data(tx) for tx in obj["transactions"]],
+        )
+    except KeyError as e:
+        raise EngineSerdeError(f"payload missing field {e.args[0]!r}") from None
+    has_withdrawals = "withdrawals" in obj
+    wants_withdrawals = fork in (ForkName.capella, ForkName.eip4844)
+    if has_withdrawals != wants_withdrawals:
+        raise EngineSerdeError(
+            f"{fork.value} payload and 'withdrawals' field disagree "
+            f"(present={has_withdrawals})"
+        )
+    if wants_withdrawals:
+        kwargs["withdrawals"] = [withdrawal_from_json(w) for w in obj["withdrawals"]]
+    has_excess = "excessDataGas" in obj
+    wants_excess = fork is ForkName.eip4844
+    if has_excess != wants_excess:
+        raise EngineSerdeError(
+            f"{fork.value} payload and 'excessDataGas' field disagree "
+            f"(present={has_excess})"
+        )
+    if wants_excess:
+        kwargs["excess_data_gas"] = parse_quantity(obj["excessDataGas"])
+    return cls(**kwargs)
+
+
+# -- PayloadAttributes ------------------------------------------------------
+
+
+def payload_attributes_to_json(attrs: dict, version: int) -> dict:
+    """Internal attributes dict (MockExecutionEngine's format: fork,
+    timestamp, prev_randao, suggested_fee_recipient, withdrawals,
+    parent_beacon_block_root) → engine PayloadAttributesV{1,2,3}."""
+    obj = {
+        "timestamp": quantity(attrs["timestamp"]),
+        "prevRandao": data(attrs["prev_randao"]),
+        "suggestedFeeRecipient": data(
+            attrs.get("suggested_fee_recipient", b"\x00" * 20)
+        ),
+    }
+    if version >= 2:
+        obj["withdrawals"] = [
+            withdrawal_to_json(w) for w in attrs.get("withdrawals", ())
+        ]
+    elif attrs.get("withdrawals"):
+        # silently dropping withdrawals here would make the EL build a
+        # bellatrix-shaped payload for a capella slot — the classic
+        # "forgot the fork tag" caller bug; fail loudly instead
+        raise EngineSerdeError(
+            "attributes carry withdrawals but PayloadAttributesV1 cannot "
+            "(missing/wrong 'fork' tag?)"
+        )
+    if version >= 3:
+        # required by the spec's PayloadAttributesV3 — a real EL answers
+        # -38003 Invalid payload attributes without it, so omission must
+        # fail in-repo too
+        root = attrs.get("parent_beacon_block_root")
+        if root is None:
+            raise EngineSerdeError(
+                "PayloadAttributesV3 requires parent_beacon_block_root"
+            )
+        obj["parentBeaconBlockRoot"] = data(root)
+    return obj
+
+
+def payload_attributes_from_json(obj: dict, version: int) -> dict:
+    """Engine PayloadAttributesV{1,2,3} → the internal attributes dict
+    MockExecutionEngine consumes, fork-tagged by structure version."""
+    attrs = {
+        "fork": FORK_BY_ENGINE_VERSION[version],
+        "timestamp": parse_quantity(obj["timestamp"]),
+        "prev_randao": parse_data(obj["prevRandao"], 32),
+        "suggested_fee_recipient": parse_data(obj["suggestedFeeRecipient"], 20),
+    }
+    if version >= 2:
+        if "withdrawals" not in obj:
+            raise EngineSerdeError(
+                f"PayloadAttributesV{version} requires 'withdrawals'"
+            )
+        attrs["withdrawals"] = [
+            withdrawal_from_json(w) for w in obj["withdrawals"]
+        ]
+    elif "withdrawals" in obj:
+        raise EngineSerdeError("PayloadAttributesV1 must not carry withdrawals")
+    if version >= 3:
+        if "parentBeaconBlockRoot" not in obj:
+            raise EngineSerdeError(
+                "PayloadAttributesV3 requires parentBeaconBlockRoot"
+            )
+        attrs["parent_beacon_block_root"] = parse_data(
+            obj["parentBeaconBlockRoot"], 32
+        )
+    return attrs

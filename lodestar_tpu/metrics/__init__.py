@@ -147,6 +147,33 @@ class LodestarMetrics:
             "Blinded blocks revealed via submitBlindedBlock",
             registry=registry,
         )
+        # live execution seam (versioned Engine API + HTTP eth1 provider;
+        # panels in dashboards/lodestar_tpu_execution_el.json, pinned by
+        # tests/test_dashboards.py)
+        self.engine_rpc_seconds = Histogram(
+            f"{ns}_engine_rpc_seconds",
+            "Engine JSON-RPC round-trip latency by method (the label value "
+            "carries the structure version, e.g. engine_newPayloadV2)",
+            ["method"],
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5),
+            registry=registry,
+        )
+        self.engine_rpc_errors_total = Counter(
+            f"{ns}_engine_rpc_errors_total",
+            "Engine JSON-RPC failures by method and kind",
+            ["method", "kind"],  # rpc_error | http | transport
+            registry=registry,
+        )
+        self.eth1_sync_lag_blocks = Gauge(
+            f"{ns}_eth1_sync_lag_blocks",
+            "Eth1 follow head minus the deposit tracker's synced block",
+            registry=registry,
+        )
+        self.eth1_deposit_events_total = Counter(
+            f"{ns}_eth1_deposit_events_total",
+            "DepositEvent logs ingested by the deposit tracker",
+            registry=registry,
+        )
         # block production (api/impl produceBlock role)
         self.blocks_produced_total = Counter(
             f"{ns}_blocks_produced_total",

@@ -1,0 +1,429 @@
+"""HTTP-served mock execution layer (reference: the mergemock /
+mock-EL role the reference sim tests drive over real JSON-RPC).
+
+Wraps the in-process doubles — ``MockExecutionEngine`` (engine_* API)
+and ``MockEth1Provider`` (eth_* deposit/log API) — behind a real aiohttp
+JSON-RPC endpoint with Engine-API JWT verification, so e2e tests
+exercise the full serialize→HTTP→deserialize loop the production
+clients speak, not the in-memory shortcut.
+
+Version strictness is the point: ``engine_newPayloadV1`` parses a
+bellatrix body (withdrawals rejected), V2 capella, V3 eip4844 (blob
+versioned hashes + parentBeaconBlockRoot params), and
+``engine_getPayloadVn`` refuses to serve a payload of a different
+fork (-38005 Unsupported fork) — a client selecting the wrong version
+for a fork must fail the test, not silently round-trip.
+
+Also runnable as a second process (mirroring tests/test_cli_node.py)::
+
+    python -m lodestar_tpu.testing.mock_el_server \
+        --port 0 --jwt-secret-file jwt.hex --deposits 4
+
+prints ``{"url": ..., "port": ...}`` on stdout once listening.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import List, Optional
+
+from lodestar_tpu.eth1 import MockEth1Provider
+from lodestar_tpu.eth1.http_provider import (
+    DEPOSIT_EVENT_TOPIC,
+    _abi_encode_bytes_tuple,
+)
+from lodestar_tpu.execution import serde
+from lodestar_tpu.execution.engine import (
+    SUPPORTED_ENGINE_METHODS,
+    MockExecutionEngine,
+)
+from lodestar_tpu.utils import get_logger
+
+# Engine API auth spec: iat must be within ±60 s of the EL's clock
+JWT_MAX_AGE_S = 60
+
+# JSON-RPC / Engine API error codes
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+UNKNOWN_PAYLOAD = -38001
+UNSUPPORTED_FORK = -38005
+
+_FORK_BY_VERSION = serde.FORK_BY_ENGINE_VERSION
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class MockElServer:
+    """One aiohttp JSON-RPC endpoint serving both the engine_* and the
+    eth_* namespaces from the shared in-process doubles."""
+
+    def __init__(
+        self,
+        engine: Optional[MockExecutionEngine] = None,
+        eth1: Optional[MockEth1Provider] = None,
+        jwt_secret: Optional[bytes] = None,
+        deposit_contract: Optional[str] = None,
+    ):
+        from lodestar_tpu.eth1.http_provider import MAINNET_DEPOSIT_CONTRACT
+
+        self.engine = engine if engine is not None else MockExecutionEngine()
+        self.eth1 = eth1 if eth1 is not None else MockEth1Provider()
+        self.jwt_secret = jwt_secret
+        self.deposit_contract = (deposit_contract or MAINNET_DEPOSIT_CONTRACT).lower()
+        self.calls: List[str] = []  # method names, in arrival order
+        self.auth_failures: List[str] = []  # rejection reasons, for tests
+        # last payload served by getPayload / received by newPayload, so
+        # e2e tests can assert byte-identity across the HTTP loop
+        self.last_served_payload = None
+        self.last_received_payload = None
+        self.last_new_payload_extra = None  # (versioned_hashes, parent_root)
+        self._log = get_logger("mock-el")
+        self._runner = None
+        self.url: Optional[str] = None
+        self.port: Optional[int] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def build_app(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/", self._handle)
+        return app
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        from aiohttp import web
+
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://{host}:{self.port}"
+        return self.url
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- auth -----------------------------------------------------------
+
+    def _jwt_rejection(self, request) -> Optional[str]:
+        """None when the Bearer JWT verifies; else the rejection reason
+        (missing / malformed / bad signature / missing or stale iat)."""
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return "missing token"
+        parts = auth[len("Bearer "):].split(".")
+        if len(parts) != 3:
+            return "malformed token"
+        header_b64, claims_b64, sig_b64 = parts
+        expected = _b64url(
+            hmac.new(
+                self.jwt_secret,
+                f"{header_b64}.{claims_b64}".encode(),
+                hashlib.sha256,
+            ).digest()
+        )
+        if not hmac.compare_digest(sig_b64, expected):
+            return "bad signature"
+        try:
+            claims = json.loads(_b64url_decode(claims_b64))
+        except (ValueError, UnicodeDecodeError):
+            return "malformed claims"
+        iat = claims.get("iat")
+        if not isinstance(iat, (int, float)):
+            return "missing iat"
+        if abs(time.time() - iat) > JWT_MAX_AGE_S:
+            return "stale iat"
+        return None
+
+    # -- dispatch -------------------------------------------------------
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response(
+                _error_body(None, INVALID_REQUEST, "body is not JSON"),
+            )
+        rpc_id = body.get("id")
+        method = body.get("method", "")
+        params = body.get("params", [])
+        self.calls.append(method)
+        if method.startswith("engine_") and self.jwt_secret is not None:
+            reason = self._jwt_rejection(request)
+            if reason is not None:
+                self.auth_failures.append(reason)
+                return web.json_response(
+                    _error_body(rpc_id, INVALID_REQUEST, f"unauthorized: {reason}"),
+                    status=401,
+                )
+        handler = getattr(self, "_rpc_" + method.replace("_", "__"), None)
+        if handler is None:
+            return web.json_response(
+                _error_body(rpc_id, METHOD_NOT_FOUND, f"unknown method {method}")
+            )
+        try:
+            result = await handler(params)
+        except RpcError as e:
+            return web.json_response(_error_body(rpc_id, e.code, e.message))
+        except (serde.EngineSerdeError, KeyError, ValueError, TypeError) as e:
+            return web.json_response(
+                _error_body(rpc_id, INVALID_PARAMS, f"{type(e).__name__}: {e}")
+            )
+        return web.json_response({"jsonrpc": "2.0", "id": rpc_id, "result": result})
+
+    # -- engine namespace ----------------------------------------------
+
+    async def _rpc_engine__exchangeCapabilities(self, params):
+        # the client's own list, so mock capabilities can never drift
+        # from what HttpExecutionEngine actually issues
+        return list(SUPPORTED_ENGINE_METHODS)
+
+    async def _new_payload(self, params, version: int):
+        fork = _FORK_BY_VERSION[version]
+        payload = serde.payload_from_json(fork, params[0])
+        self.last_received_payload = payload
+        if version >= 3:
+            if len(params) < 3:
+                raise RpcError(
+                    INVALID_PARAMS,
+                    "newPayloadV3 takes (payload, versionedHashes, "
+                    "parentBeaconBlockRoot)",
+                )
+            hashes = [serde.parse_data(h, 32) for h in params[1]]
+            parent_root = serde.parse_data(params[2], 32)
+            self.last_new_payload_extra = (hashes, parent_root)
+        status = self.engine.notify_new_payload_sync_status(payload)
+        return _payload_status_json(status)
+
+    async def _rpc_engine__newPayloadV1(self, params):
+        return await self._new_payload(params, 1)
+
+    async def _rpc_engine__newPayloadV2(self, params):
+        return await self._new_payload(params, 2)
+
+    async def _rpc_engine__newPayloadV3(self, params):
+        return await self._new_payload(params, 3)
+
+    async def _forkchoice_updated(self, params, version: int):
+        fc = params[0]
+        attrs_json = params[1] if len(params) > 1 else None
+        head = serde.parse_data(fc["headBlockHash"], 32)
+        safe = serde.parse_data(fc["safeBlockHash"], 32)
+        finalized = serde.parse_data(fc["finalizedBlockHash"], 32)
+        attrs = (
+            serde.payload_attributes_from_json(attrs_json, version)
+            if attrs_json is not None
+            else None
+        )
+        pid = await self.engine.notify_forkchoice_update(head, safe, finalized, attrs)
+        return {
+            "payloadStatus": {
+                "status": "VALID",
+                "latestValidHash": serde.data(head),
+                "validationError": None,
+            },
+            "payloadId": serde.data(pid) if pid is not None else None,
+        }
+
+    async def _rpc_engine__forkchoiceUpdatedV1(self, params):
+        return await self._forkchoice_updated(params, 1)
+
+    async def _rpc_engine__forkchoiceUpdatedV2(self, params):
+        return await self._forkchoice_updated(params, 2)
+
+    async def _rpc_engine__forkchoiceUpdatedV3(self, params):
+        return await self._forkchoice_updated(params, 3)
+
+    async def _get_payload(self, params, version: int):
+        pid = serde.parse_data(params[0], 8)
+        try:
+            payload = await self.engine.get_payload(pid)
+        except ValueError as e:
+            raise RpcError(UNKNOWN_PAYLOAD, str(e)) from None
+        built_version = serde.engine_version_for_fork(
+            serde.fork_of_payload(payload)
+        )
+        if built_version != version:
+            raise RpcError(
+                UNSUPPORTED_FORK,
+                f"payload is a V{built_version} structure, asked via V{version}",
+            )
+        self.last_served_payload = payload
+        body = serde.payload_to_json(payload)
+        if version == 1:
+            return body
+        result = {"executionPayload": body, "blockValue": "0x0"}
+        if version >= 3:
+            result["blobsBundle"] = {"commitments": [], "proofs": [], "blobs": []}
+        return result
+
+    async def _rpc_engine__getPayloadV1(self, params):
+        return await self._get_payload(params, 1)
+
+    async def _rpc_engine__getPayloadV2(self, params):
+        return await self._get_payload(params, 2)
+
+    async def _rpc_engine__getPayloadV3(self, params):
+        return await self._get_payload(params, 3)
+
+    # -- eth namespace (deposit tracking) -------------------------------
+
+    async def _rpc_eth__blockNumber(self, params):
+        return hex(await self.eth1.get_block_number())
+
+    async def _rpc_eth__getBlockByNumber(self, params):
+        tag = params[0]
+        if tag == "latest":
+            number = await self.eth1.get_block_number()
+        else:
+            number = int(tag, 16)
+        blk = await self.eth1.get_block(number)
+        if blk is None:
+            return None
+        return {
+            "number": hex(blk.number),
+            "hash": "0x" + bytes(blk.hash).hex(),
+            "timestamp": hex(blk.timestamp),
+        }
+
+    async def _rpc_eth__getLogs(self, params):
+        flt = params[0]
+        address = str(flt.get("address", "")).lower()
+        if address and address != self.deposit_contract:
+            return []
+        topics = flt.get("topics") or []
+        if topics and topics[0] != DEPOSIT_EVENT_TOPIC:
+            return []
+        frm = int(flt["fromBlock"], 16)
+        to = int(flt["toBlock"], 16)
+        logs = []
+        for ev in await self.eth1.get_deposit_events(frm, to):
+            dd = ev.deposit_data
+            data = _abi_encode_bytes_tuple(
+                [
+                    bytes(dd.pubkey),
+                    bytes(dd.withdrawal_credentials),
+                    int(dd.amount).to_bytes(8, "little"),
+                    bytes(dd.signature),
+                    int(ev.index).to_bytes(8, "little"),
+                ]
+            )
+            logs.append(
+                {
+                    "address": self.deposit_contract,
+                    "topics": [DEPOSIT_EVENT_TOPIC],
+                    "data": "0x" + data.hex(),
+                    "blockNumber": hex(ev.block_number),
+                    "logIndex": hex(ev.index),
+                    "removed": False,
+                }
+            )
+        return logs
+
+
+class RpcError(Exception):
+    """Handler-raised JSON-RPC error (code + message)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+def _error_body(rpc_id, code: int, message: str) -> dict:
+    return {
+        "jsonrpc": "2.0",
+        "id": rpc_id,
+        "error": {"code": code, "message": message},
+    }
+
+
+def _payload_status_json(status) -> dict:
+    lvh = status.latest_valid_hash
+    return {
+        "status": str(getattr(status.status, "value", status.status)),
+        "latestValidHash": serde.data(lvh) if lvh is not None else None,
+        "validationError": status.validation_error,
+    }
+
+
+def scripted_deposit_data(index: int):
+    """Deterministic DepositData for second-process scripts — structural
+    only (no real BLS signature; the tracker never verifies them)."""
+    from lodestar_tpu.types import ssz
+
+    return ssz.phase0.DepositData(
+        pubkey=bytes([0xD0 + (index % 16)]) * 48,
+        withdrawal_credentials=index.to_bytes(4, "big").rjust(32, b"\x00"),
+        amount=32_000_000_000,
+        signature=bytes([index % 256]) * 96,
+    )
+
+
+def main(argv=None) -> int:
+    """Second-process entry: serve until killed, announcing the bound
+    port as a JSON line on stdout (tests/test_cli_node.py idiom)."""
+    import argparse
+    import asyncio
+
+    parser = argparse.ArgumentParser(prog="mock-el-server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--jwt-secret-file", default=None)
+    parser.add_argument(
+        "--deposits", type=int, default=0,
+        help="script N deterministic deposits into the eth1 chain",
+    )
+    parser.add_argument(
+        "--blocks", type=int, default=8,
+        help="extra eth1 blocks appended after the scripted deposits",
+    )
+    args = parser.parse_args(argv)
+
+    jwt_secret = None
+    if args.jwt_secret_file:
+        with open(args.jwt_secret_file) as f:
+            jwt_secret = bytes.fromhex(f.read().strip().removeprefix("0x"))
+
+    eth1 = MockEth1Provider()
+    for i in range(args.deposits):
+        eth1.add_deposit(scripted_deposit_data(i))
+    eth1.add_blocks(args.blocks)
+    server = MockElServer(eth1=eth1, jwt_secret=jwt_secret)
+
+    async def run():
+        url = await server.start(args.host, args.port)
+        print(json.dumps({"url": url, "port": server.port}), flush=True)
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await server.close()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

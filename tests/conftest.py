@@ -85,6 +85,7 @@ _E2E_FILES = {
     "test_wire_transport.py",
     "test_dryrun_artifact.py",
     "test_official_vectors.py",
+    "test_mock_el_process.py",
 }
 # correct but minutes-long single-process suites: neither fast nor e2e
 _SLOW_FILES = {
@@ -117,7 +118,9 @@ _FAST_FILES = {
     "test_aot.py",
     "test_dashboards.py",
     "test_db.py",
+    "test_engine_http.py",
     "test_eth1.py",
+    "test_eth1_http.py",
     "test_faults.py",
     "test_fork_choice.py",
     "test_gossip_scoring.py",

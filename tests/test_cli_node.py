@@ -39,6 +39,65 @@ def _get(url):
         return json.loads(r.read())
 
 
+class TestExecutionSeamConstruction:
+    """`lodestar-tpu beacon --execution-url … --jwt-secret-file …` must
+    construct the HTTP execution clients (no network touched at
+    construction time); without the flags the node's default in-process
+    behavior is unchanged."""
+
+    def test_execution_flags_construct_http_clients(self, tmp_path):
+        from lodestar_tpu.cli.main import (
+            build_eth1_provider,
+            build_execution_engine,
+            build_parser,
+        )
+        from lodestar_tpu.eth1.http_provider import HttpEth1Provider
+        from lodestar_tpu.execution.engine import HttpExecutionEngine
+
+        secret = bytes(range(32))
+        jwt = tmp_path / "jwt.hex"
+        jwt.write_text("0x" + secret.hex() + "\n")
+        args = build_parser().parse_args(
+            [
+                "beacon",
+                "--execution-url", "http://127.0.0.1:8551",
+                "--jwt-secret-file", str(jwt),
+                "--eth1-url", "http://127.0.0.1:8545",
+                "--deposit-contract", "0x" + "42" * 20,
+            ]
+        )
+        engine = build_execution_engine(args)
+        assert isinstance(engine, HttpExecutionEngine)
+        assert engine.url == "http://127.0.0.1:8551"
+        assert engine.jwt_secret == secret
+        provider = build_eth1_provider(args)
+        assert isinstance(provider, HttpEth1Provider)
+        assert provider.deposit_contract == "0x" + "42" * 20
+
+    def test_defaults_without_flags_are_unchanged(self):
+        from lodestar_tpu.cli.main import (
+            build_eth1_provider,
+            build_execution_engine,
+            build_parser,
+        )
+
+        args = build_parser().parse_args(["beacon"])
+        assert build_execution_engine(args) is None
+        assert build_eth1_provider(args) is None
+
+    def test_bad_jwt_secret_file_is_a_clean_cli_error(self, tmp_path):
+        from lodestar_tpu.cli.main import build_execution_engine, build_parser
+
+        jwt = tmp_path / "jwt.hex"
+        jwt.write_text("0xdeadbeef\n")  # 4 bytes, not 32
+        args = build_parser().parse_args(
+            ["beacon", "--execution-url", "http://127.0.0.1:8551",
+             "--jwt-secret-file", str(jwt)]
+        )
+        with pytest.raises(SystemExit, match="32 bytes"):
+            build_execution_engine(args)
+
+
 class TestBeaconValidatorProcesses:
     def test_beacon_plus_validator_over_rest(self):
         rest = _free_port()

@@ -107,3 +107,37 @@ def test_bls_pool_dashboard_pins_breaker_and_degradation_series():
         s for s in _PINNED_BLS_FAULT_SERIES if _base(s) not in exported_bases
     }
     assert not unexported, f"pinned series not exported: {sorted(unexported)}"
+
+
+# Execution-seam series the EL dashboard must keep targeting (ISSUE 9):
+# a node on the wrong engine version for a fork, a flapping EL, or a
+# stalled deposit sync must be VISIBLE on the shipped board.
+_PINNED_EL_SERIES = {
+    "lodestar_tpu_engine_rpc_seconds",
+    "lodestar_tpu_engine_rpc_errors_total",
+    "lodestar_tpu_eth1_sync_lag_blocks",
+    "lodestar_tpu_eth1_deposit_events_total",
+}
+
+
+def test_execution_el_dashboard_pins_engine_and_eth1_series():
+    path = os.path.join(_DASH_DIR, "lodestar_tpu_execution_el.json")
+    dash = json.load(open(path))
+    targeted = set()
+    for panel in dash.get("panels", []):
+        for target in panel.get("targets", []):
+            targeted.update(_METRIC_RE.findall(target.get("expr", "")))
+    targeted_bases = {_base(n) for n in targeted}
+    missing = {
+        s for s in _PINNED_EL_SERIES
+        if s not in targeted and _base(s) not in targeted_bases
+    }
+    assert not missing, (
+        f"execution-EL dashboard lost its seam panels: {sorted(missing)}"
+    )
+    # and the exporter really exports them (both directions pinned)
+    exported_bases = {_base(n) for n in _exported_names()}
+    unexported = {
+        s for s in _PINNED_EL_SERIES if _base(s) not in exported_bases
+    }
+    assert not unexported, f"pinned series not exported: {sorted(unexported)}"

@@ -1,0 +1,326 @@
+"""HTTP JSON-RPC eth1 provider (reference provider/eth1Provider.ts):
+deposit tracking over real HTTP against the mock EL server, equivalence
+with the in-memory provider on the same script, chunked eth_getLogs,
+DepositEvent ABI decoding, and the `eth1.provider.http` chaos seam
+(docs/FAULTS.md).
+"""
+import asyncio
+
+import pytest
+
+from lodestar_tpu.config import minimal_chain_config as cfg
+from lodestar_tpu.eth1 import Eth1DepositDataTracker, MockEth1Provider
+from lodestar_tpu.eth1.http_provider import (
+    DEPOSIT_EVENT_TOPIC,
+    Eth1HttpError,
+    Eth1RpcError,
+    HttpEth1Provider,
+    _abi_encode_bytes_tuple,
+    decode_deposit_log,
+)
+from lodestar_tpu.params import ACTIVE_PRESET_NAME
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.testing import faults
+from lodestar_tpu.testing.mock_el_server import (
+    MockElServer,
+    scripted_deposit_data,
+)
+from lodestar_tpu.types import ssz
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _scripted_eth1(deposits=4, extra_blocks=6) -> MockEth1Provider:
+    eth1 = MockEth1Provider()
+    for i in range(deposits):
+        eth1.add_deposit(scripted_deposit_data(i))
+    eth1.add_blocks(extra_blocks)
+    return eth1
+
+
+async def _with_provider(fn, eth1=None, **provider_kwargs):
+    server = MockElServer(eth1=eth1 if eth1 is not None else _scripted_eth1())
+    url = await server.start()
+    provider = HttpEth1Provider(url, **provider_kwargs)
+    try:
+        return await fn(provider, server)
+    finally:
+        await provider.close()
+        await server.close()
+
+
+# ---------------------------------------------------------------------------
+# DepositEvent ABI decoding
+# ---------------------------------------------------------------------------
+
+
+class TestDepositLogAbi:
+    def test_decode_round_trips_the_contract_encoding(self):
+        dd = scripted_deposit_data(3)
+        data = _abi_encode_bytes_tuple(
+            [
+                bytes(dd.pubkey),
+                bytes(dd.withdrawal_credentials),
+                int(dd.amount).to_bytes(8, "little"),
+                bytes(dd.signature),
+                (7).to_bytes(8, "little"),
+            ]
+        )
+        ev = decode_deposit_log(
+            {"data": "0x" + data.hex(), "blockNumber": "0x1c"}
+        )
+        assert ev.index == 7
+        assert ev.block_number == 0x1C
+        assert ssz.phase0.DepositData.serialize(ev.deposit_data) == (
+            ssz.phase0.DepositData.serialize(dd)
+        )
+
+    def test_abi_layout_is_the_standard_dynamic_bytes_head_tail(self):
+        """Head = 5 offsets; first tail begins at 0xa0 with a 32-byte
+        length word — the exact layout the mainnet contract emits."""
+        data = _abi_encode_bytes_tuple([b"\x01" * 48, b"\x02" * 32,
+                                        b"\x03" * 8, b"\x04" * 96, b"\x05" * 8])
+        assert int.from_bytes(data[0:32], "big") == 0xA0
+        assert int.from_bytes(data[0xA0 : 0xA0 + 32], "big") == 48
+        assert data[0xA0 + 32 : 0xA0 + 32 + 48] == b"\x01" * 48
+
+    def test_wrong_field_width_is_rejected(self):
+        bad = _abi_encode_bytes_tuple(
+            [b"\x01" * 47, b"\x02" * 32, b"\x03" * 8, b"\x04" * 96, b"\x05" * 8]
+        )
+        with pytest.raises(ValueError, match="widths"):
+            decode_deposit_log({"data": "0x" + bad.hex(), "blockNumber": "0x0"})
+
+
+# ---------------------------------------------------------------------------
+# e2e: tracker over HTTP == tracker over the in-memory provider
+# ---------------------------------------------------------------------------
+
+
+class TestTrackerOverHttp:
+    def test_http_tracker_matches_in_memory_tracker_on_same_script(self):
+        """Acceptance: Eth1DepositDataTracker.update() against
+        HttpEth1Provider + mock EL server ingests scripted deposits over
+        HTTP and serves identical eth1 vote + deposit proofs as the
+        in-memory MockEth1Provider on the same script."""
+        # 8 genesis-validator deposits + 2 new ones (test_eth1's script),
+        # so the tracker's vote must BEAT the state's genesis eth1_data
+        eth1 = _scripted_eth1(deposits=10, extra_blocks=300)
+
+        async def go(provider, server):
+            http_tracker = Eth1DepositDataTracker(provider, cfg)
+            mem_tracker = Eth1DepositDataTracker(eth1, cfg)
+            n_http = await http_tracker.update()
+            n_mem = await mem_tracker.update()
+            assert n_http == n_mem == 10
+            return http_tracker, mem_tracker
+
+        http_tracker, mem_tracker = run(_with_provider(go, eth1=eth1))
+
+        # identical deposit trees (→ identical proofs at every count)
+        assert http_tracker.tree.count() == mem_tracker.tree.count() == 10
+        for count in range(1, 11):
+            assert http_tracker.tree.root_at(count) == (
+                mem_tracker.tree.root_at(count)
+            )
+            for i in range(count):
+                assert http_tracker.tree.proof(i, count) == (
+                    mem_tracker.tree.proof(i, count)
+                )
+        # identical block caches (→ identical candidate windows)
+        assert [
+            (b.number, b.hash, b.timestamp) for b in http_tracker.block_cache
+        ] == [(b.number, b.hash, b.timestamp) for b in mem_tracker.block_cache]
+
+        # identical eth1 vote on a state whose voting window covers the chain
+        _, state = init_dev_state(cfg, 8, genesis_time=0)
+        follow = cfg.ETH1_FOLLOW_DISTANCE * cfg.SECONDS_PER_ETH1_BLOCK
+        state.genesis_time = 300 * 14 + follow
+        vote_http = http_tracker.get_eth1_vote(state)
+        vote_mem = mem_tracker.get_eth1_vote(state)
+        assert ssz.phase0.Eth1Data.serialize(vote_http) == (
+            ssz.phase0.Eth1Data.serialize(vote_mem)
+        )
+        assert vote_http.deposit_count == 10
+        # an actual eth1-chain candidate, not the state-data fallback
+        assert bytes(vote_http.block_hash).startswith(b"\xe1")
+
+        # identical deposits-due (indices 8, 9) with proofs under that vote
+        state.eth1_data = vote_http
+        deps_http = http_tracker.get_deposits(state)
+        deps_mem = mem_tracker.get_deposits(state)
+        assert len(deps_http) == len(deps_mem) == 2
+        for a, b in zip(deps_http, deps_mem):
+            assert ssz.phase0.Deposit.serialize(a) == ssz.phase0.Deposit.serialize(b)
+
+    def test_get_logs_is_chunked(self):
+        """A follow range wider than log_chunk_size must be fetched in
+        bounded eth_getLogs windows, not one provider-killing range."""
+        eth1 = _scripted_eth1(deposits=3, extra_blocks=9)  # head = block 9
+
+        async def go(provider, server):
+            tracker = Eth1DepositDataTracker(provider, cfg)
+            n = await tracker.update()
+            assert n == 3
+            # blocks 0..9 with chunk 4 → ranges [0,3] [4,7] [8,9]
+            assert server.calls.count("eth_getLogs") == 3
+            assert tracker._synced_to == 9
+
+        run(_with_provider(go, eth1=eth1, log_chunk_size=4))
+
+    def test_get_block_matches_mock(self):
+        async def go(provider, server):
+            head = await provider.get_block_number()
+            assert head == await server.eth1.get_block_number()
+            blk = await provider.get_block(2)
+            mock_blk = await server.eth1.get_block(2)
+            assert (blk.number, blk.hash, blk.timestamp) == (
+                mock_blk.number, mock_blk.hash, mock_blk.timestamp
+            )
+            assert await provider.get_block(10_000) is None
+
+        run(_with_provider(go))
+
+
+# ---------------------------------------------------------------------------
+# chaos: the eth1.provider.http seam (docs/FAULTS.md)
+# ---------------------------------------------------------------------------
+
+
+def conn_error():
+    import aiohttp
+
+    return aiohttp.ClientConnectionError("injected: connection reset")
+
+
+class _CannedProvider(HttpEth1Provider):
+    """Transport-free provider: _post_once replays canned bodies."""
+
+    def __init__(self, responses):
+        super().__init__("http://127.0.0.1:1")
+        self._responses = list(responses)
+        self.posts = 0
+
+    async def _post_once(self, method, params):
+        self.posts += 1
+        r = self._responses[min(self.posts - 1, len(self._responses) - 1)]
+        if isinstance(r, BaseException):
+            raise r
+        return r
+
+
+class TestEth1Chaos:
+    def test_retry_exhaustion_surfaces_transport_fault(self):
+        from lodestar_tpu.execution.http_session import RETRY_ATTEMPTS
+
+        provider = _CannedProvider([{"result": "0x0"}])
+
+        async def go():
+            with faults.inject("eth1.provider.http", error=conn_error) as plan:
+                with pytest.raises(Exception, match="connection reset"):
+                    await provider.get_block_number()
+                return plan.calls
+
+        assert run(go()) == RETRY_ATTEMPTS  # bounded, then surfaced
+        assert provider.posts == 0  # the fault fired before transport
+
+    def test_transient_fault_retries_then_succeeds(self):
+        provider = _CannedProvider([{"result": "0x2a"}])
+
+        async def go():
+            with faults.inject(
+                "eth1.provider.http", times=2, error=conn_error
+            ) as plan:
+                head = await provider.get_block_number()
+                return head, plan.calls
+
+        assert run(go()) == (42, 3)
+
+    def test_5xx_retries_and_rpc_error_does_not(self):
+        provider = _CannedProvider(
+            [Eth1HttpError("eth_blockNumber", 503), {"result": "0x1"}]
+        )
+        assert run(provider.get_block_number()) == 1
+        assert provider.posts == 2
+
+        provider2 = _CannedProvider(
+            [{"error": {"code": -32005, "message": "limit exceeded"}}]
+        )
+
+        async def go():
+            with pytest.raises(Eth1RpcError) as ei:
+                await provider2.get_block_number()
+            return ei.value
+
+        err = run(go())
+        assert (err.code, err.message) == (-32005, "limit exceeded")
+        assert provider2.posts == 1
+
+    def test_mid_sync_fault_does_not_advance_synced_to(self):
+        """If get_deposit_events fails mid-range the tracker must NOT
+        advance _synced_to past the failed range — the retry after the
+        fault clears must ingest every event exactly once."""
+        eth1 = _scripted_eth1(deposits=4, extra_blocks=6)
+
+        async def go(provider, server):
+            tracker = Eth1DepositDataTracker(provider, cfg)
+            # call 0 (eth_blockNumber) passes, call 1 (first eth_getLogs
+            # chunk) faults; schedule exhausts afterwards so the retry
+            # inside request_with_retry ALSO sees pass — use fail-always
+            # scoped to one update() instead
+            with faults.inject("eth1.provider.http", script=[False] + [True] * 8,
+                               error=conn_error) as plan:
+                with pytest.raises(Exception, match="connection reset"):
+                    await tracker.update()
+                assert plan.fired >= 1
+            assert tracker._synced_to == -1  # nothing banked from the failure
+            assert tracker.tree.count() == 0
+            # fault cleared: a clean retry ingests the full script once
+            n = await tracker.update()
+            assert n == 4
+            assert tracker.tree.count() == 4
+            assert tracker._synced_to == await server.eth1.get_block_number()
+
+        run(_with_provider(go, eth1=eth1))
+
+    def test_get_block_fault_after_ingestion_does_not_wedge_tracker(self):
+        """A fault AFTER the deposit logs landed (the block-cache fetch)
+        leaves events ingested but _synced_to behind — the retry
+        re-delivers the same deposit range and must treat the replayed
+        indices as no-ops, not die on its own 'deposit log gap' assert
+        on every poll forever."""
+        eth1 = _scripted_eth1(deposits=4, extra_blocks=6)
+
+        async def go(provider, server):
+            tracker = Eth1DepositDataTracker(provider, cfg)
+            # call 0 eth_blockNumber and call 1 eth_getLogs (one chunk
+            # covers the range) pass; the first eth_getBlockByNumber
+            # attempt and its retries fault
+            with faults.inject(
+                "eth1.provider.http", script=[False, False] + [True] * 8,
+                error=conn_error,
+            ) as plan:
+                with pytest.raises(Exception, match="connection reset"):
+                    await tracker.update()
+                assert plan.fired >= 1
+            assert tracker.tree.count() == 4  # events landed pre-fault
+            assert len(tracker.deposit_events) == 4
+            assert tracker._synced_to == -1  # but the range is not banked
+            # the retry replays the same range: no gap assert, no double
+            # ingestion, and the block cache holds no duplicates
+            n = await tracker.update()
+            assert n == 0  # nothing NEW ingested by the replay
+            assert tracker.tree.count() == 4
+            assert len(tracker.deposit_events) == 4
+            head = await server.eth1.get_block_number()
+            assert tracker._synced_to == head
+            numbers = [b.number for b in tracker.block_cache]
+            assert numbers == sorted(set(numbers))  # no duplicates
+
+        run(_with_provider(go, eth1=eth1))

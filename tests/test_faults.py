@@ -581,16 +581,18 @@ class TestEngineRetry:
 
         eng = FakeEngine(
             [
-                EngineHttpError("engine_getPayloadV1", 503),
-                EngineHttpError("engine_getPayloadV1", 502),
-                {"result": {"ok": True}},
+                EngineHttpError("engine_forkchoiceUpdatedV1", 503),
+                EngineHttpError("engine_forkchoiceUpdatedV1", 502),
+                {"result": {"payloadId": "0x0000000000000001"}},
             ]
         )
 
         async def go():
-            return await eng.get_payload(b"\x00" * 8)
+            return await eng.notify_forkchoice_update(
+                b"\x00" * 32, b"\x00" * 32, b"\x00" * 32
+            )
 
-        assert run(go()) == {"ok": True}
+        assert run(go()) == b"\x00" * 7 + b"\x01"
         assert eng.posts == 3
 
     def test_retries_are_bounded(self):
@@ -608,13 +610,20 @@ class TestEngineRetry:
         assert run(go()) == RETRY_ATTEMPTS
 
     def test_rpc_error_response_is_not_retried(self):
+        from lodestar_tpu.execution.engine import EngineRpcError
+
         eng = FakeEngine([{"error": {"code": -32000, "message": "nope"}}])
 
         async def go():
-            with pytest.raises(RuntimeError, match="nope"):
+            # typed: carries the EL's JSON-RPC code + message (and stays a
+            # RuntimeError so pre-existing except-clauses keep working)
+            with pytest.raises(EngineRpcError, match="nope") as ei:
                 await eng.get_payload(b"\x00" * 8)
+            return ei.value
 
-        run(go())
+        err = run(go())
+        assert (err.code, err.message) == (-32000, "nope")
+        assert isinstance(err, RuntimeError)
         assert eng.posts == 1
 
     def test_cancellation_is_not_retried(self):
