@@ -214,7 +214,10 @@ class BeaconRestApiServer:
         st = self._resolve_state(request.match_info["state_id"])
         if st is None:
             return _err(404, "state not found")
-        return _ok({"root": "0x" + st.hash_tree_root().hex()})
+        return _ok(
+            {"root": "0x" + st.hash_tree_root().hex()},
+            execution_optimistic=self._state_optimistic(st),
+        )
 
     async def get_state_fork(self, request):
         st = self._resolve_state(request.match_info["state_id"])
@@ -236,7 +239,8 @@ class BeaconRestApiServer:
                     ssz.phase0.Checkpoint, s.current_justified_checkpoint
                 ),
                 "finalized": to_json(ssz.phase0.Checkpoint, s.finalized_checkpoint),
-            }
+            },
+            execution_optimistic=self._state_optimistic(st),
         )
 
     def _validator_status(self, v, epoch: int) -> str:
@@ -309,14 +313,35 @@ class BeaconRestApiServer:
             return self.db.block.get(bytes.fromhex(node.block_root[2:]))
         return self.db.block_archive.get(slot)
 
+    def _optimistic_flag(self, root: bytes) -> bool:
+        return self.chain.is_optimistic_root("0x" + bytes(root).hex())
+
+    def _state_optimistic(self, st) -> bool:
+        """execution_optimistic of the RESOLVED state (beacon-API: the
+        flag is per requested resource, not per the node's head) — via
+        the block root its latest header commits to."""
+        hdr = st.state.latest_block_header
+        h = ssz.phase0.BeaconBlockHeader(
+            slot=hdr.slot, proposer_index=hdr.proposer_index,
+            parent_root=bytes(hdr.parent_root),
+            state_root=bytes(hdr.state_root),
+            body_root=bytes(hdr.body_root),
+        )
+        if bytes(h.state_root) == b"\x00" * 32:
+            h.state_root = st.hash_tree_root()
+        return self._optimistic_flag(
+            ssz.phase0.BeaconBlockHeader.hash_tree_root(h)
+        )
+
     async def get_block(self, request):
         blk = self._resolve_block(request.match_info["block_id"])
         if blk is None:
             return _err(404, "block not found")
+        root = type(blk.message).hash_tree_root(blk.message)
         return _ok(
             to_json(ssz.phase0.SignedBeaconBlock, blk),
             version="phase0",
-            execution_optimistic=False,
+            execution_optimistic=self._optimistic_flag(root),
         )
 
     async def get_block_root(self, request):
@@ -454,8 +479,14 @@ class BeaconRestApiServer:
                 "head_slot": str(head.slot),
                 "sync_distance": str(distance),
                 "is_syncing": distance > 1,
-                "is_optimistic": False,
-                "el_offline": self.chain.execution_engine is None,
+                # beacon-API: optimistic = head imported without an EL
+                # verdict; el_offline = no EL attached, or the last
+                # engine call failed at transport level
+                "is_optimistic": self.chain.is_optimistic_head(),
+                "el_offline": (
+                    self.chain.execution_engine is None
+                    or self.chain.el_offline
+                ),
             }
         )
 
@@ -578,6 +609,11 @@ class BeaconRestApiServer:
 
     async def produce_block(self, request):
         slot = int(request.match_info["slot"])
+        if self.chain.is_optimistic_head():
+            # sync/optimistic.md: an optimistic node MUST NOT produce
+            # blocks — the EL has not validated the chain it would
+            # build on (503 = beacon-API "unable to respond: syncing")
+            return _err(503, "head is optimistic (EL has not validated it)")
         randao_reveal = bytes.fromhex(
             request.query.get("randao_reveal", "0x" + "00" * 96)[2:]
         )
@@ -654,12 +690,19 @@ class BeaconRestApiServer:
             if is_merge_transition_complete(pre.state):
                 from lodestar_tpu.execution.engine import build_dev_payload
 
-                body.execution_payload = build_dev_payload(
-                    self.chain.cfg, pre.state,
-                    fee_recipient=self.fee_recipients.get(
-                        proposer, b"\x00" * 20
-                    ),
-                )
+                fee_recipient = self.fee_recipients.get(proposer, b"\x00" * 20)
+                payload = None
+                if self.chain.execution_engine is not None:
+                    payload = await self._produce_engine_payload(
+                        pre, slot, fee_recipient
+                    )
+                if payload is None:
+                    # watchdog fallback (or no engine): a complete
+                    # locally-built payload, never a half-built block
+                    payload = build_dev_payload(
+                        self.chain.cfg, pre.state, fee_recipient=fee_recipient
+                    )
+                body.execution_payload = payload
         hdr = head_state.state.latest_block_header
         parent_hdr = ssz.phase0.BeaconBlockHeader(
             slot=hdr.slot, proposer_index=hdr.proposer_index,
@@ -687,6 +730,75 @@ class BeaconRestApiServer:
             )
         return block
 
+    async def _produce_engine_payload(self, pre, slot, fee_recipient):
+        """Engine-backed payload for the proposal: forkchoiceUpdated
+        with attributes → getPayload, raced against the proposal
+        deadline (one slot interval).  Returns None on any failure —
+        the caller falls back to the locally-built payload, so a
+        stalling or refusing EL degrades production instead of killing
+        it (the watchdog counts the distinct fallback metric)."""
+        import asyncio as _asyncio
+
+        from lodestar_tpu.execution.engine import dev_payload_attributes
+        from lodestar_tpu.execution.payload_builder import (
+            PayloadDeadlineError,
+            produce_engine_payload,
+        )
+        from lodestar_tpu.params import INTERVALS_PER_SLOT
+
+        metrics = self.chain.metrics.lodestar if self.chain.metrics else None
+        try:
+            # everything from attribute building onward funnels into the
+            # fallback: a pre-request failure (serde, attribute shape)
+            # must degrade production, not 500 it
+            st = pre.state
+            clock = self.chain.clock
+            cfg = self.chain.cfg
+            # budget: until one interval into the slot (the attestation
+            # deadline); a late request still gets a small floor so a
+            # healthy EL can answer
+            deadline_s = max(
+                0.25,
+                clock.slot_start_time(slot)
+                + cfg.SECONDS_PER_SLOT / INTERVALS_PER_SLOT
+                - clock._now(),
+            )
+            attrs = dev_payload_attributes(
+                cfg, st, fee_recipient=fee_recipient,
+                parent_beacon_block_root=self.chain.head_root,
+            )
+            fin = self.chain.fork_choice.get_block(
+                self.chain.fork_choice.store.finalized.root
+            )
+            fin_hash = (
+                bytes.fromhex(fin.execution_payload_block_hash[2:])
+                if fin is not None and fin.execution_payload_block_hash
+                else b"\x00" * 32
+            )
+            head_hash = bytes(st.latest_execution_payload_header.block_hash)
+            return await produce_engine_payload(
+                self.chain.execution_engine,
+                head_block_hash=head_hash,
+                safe_block_hash=head_hash,
+                finalized_block_hash=fin_hash,
+                attrs=attrs,
+                deadline_s=deadline_s,
+                metrics=metrics,
+                log=lambda m: None,
+            )
+        except _asyncio.CancelledError:
+            raise
+        except PayloadDeadlineError:
+            return None
+        except Exception:
+            # pre-request failures (serde, attribute shape) also fall
+            # back; the fallback payload is complete either way
+            if metrics is not None:
+                metrics.produce_payload_fallbacks_total.labels(
+                    reason="error"
+                ).inc()
+            return None
+
     async def produce_blinded_block(self, request):
         """produceBlindedBlock (routes/validator.ts:168): a block whose body
         commits to an ExecutionPayloadHeader.  With a builder configured the
@@ -697,6 +809,8 @@ class BeaconRestApiServer:
         from lodestar_tpu.types import blinded_types_for, fork_of_block, types_for
 
         slot = int(request.match_info["slot"])
+        if self.chain.is_optimistic_head():
+            return _err(503, "head is optimistic (EL has not validated it)")
         randao_reveal = bytes.fromhex(
             request.query.get("randao_reveal", "0x" + "00" * 96)[2:]
         )
@@ -1052,7 +1166,7 @@ class BeaconRestApiServer:
             {
                 "slot": str(signed_block.message.slot),
                 "block": "0x" + root.hex(),
-                "execution_optimistic": False,
+                "execution_optimistic": self._optimistic_flag(root),
             },
         )
 
@@ -1065,6 +1179,7 @@ class BeaconRestApiServer:
                 "block": "0x" + root.hex(),
                 "state": head.state_root,
                 "epoch_transition": head.slot % _p.SLOTS_PER_EPOCH == 0,
+                "execution_optimistic": self._optimistic_flag(root),
             },
         )
 
@@ -1163,11 +1278,15 @@ class BeaconRestApiServer:
         heads = []
         arr = self.chain.fork_choice.proto_array
         children = {n.parent for n in arr.nodes if n.parent is not None}
+        from lodestar_tpu.fork_choice import ExecutionStatus
+
         for i, node in enumerate(arr.nodes):
             if i not in children:
                 heads.append(
                     {"root": node.block_root, "slot": str(node.slot),
-                     "execution_optimistic": False}
+                     "execution_optimistic": (
+                         node.execution_status is ExecutionStatus.Optimistic
+                     )}
                 )
         return _ok(heads)
 

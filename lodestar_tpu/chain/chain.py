@@ -58,6 +58,27 @@ _log = get_logger("chain")
 BLOCK_QUEUE_LENGTH = 256  # blocks/index.ts:17
 
 
+class ExecutionPayloadInvalidError(ValueError):
+    """The EL rejected a block's execution payload (newPayload INVALID).
+    Carries the EL's diagnostics: ``latest_valid_hash`` anchors the
+    invalidation sweep, ``validation_error`` is the EL's own message."""
+
+    def __init__(
+        self,
+        block_root: bytes,
+        latest_valid_hash: Optional[bytes] = None,
+        validation_error: Optional[str] = None,
+    ):
+        lvh = "0x" + latest_valid_hash.hex() if latest_valid_hash else None
+        super().__init__(
+            f"execution payload invalid for block 0x{block_root.hex()[:8]}: "
+            f"latestValidHash={lvh} validationError={validation_error!r}"
+        )
+        self.block_root = block_root
+        self.latest_valid_hash = latest_valid_hash
+        self.validation_error = validation_error
+
+
 class ChainEvent(str, Enum):
     block = "block"
     head = "head"
@@ -145,6 +166,13 @@ class BeaconChain:
         self.eth1 = eth1  # Eth1DepositDataTracker or None
         self.merge_tracker = merge_tracker  # Eth1MergeBlockTracker or None
         self.metrics = metrics  # lodestar_tpu.metrics.Metrics or None
+        # True while the last engine call failed at transport level
+        # (surfaced on /eth/v1/node/syncing as el_offline)
+        self.el_offline = False
+        from lodestar_tpu.config import ForkConfig
+
+        # fork schedule lookups (engine version selection per head slot)
+        self._fork_config = ForkConfig(cfg)
         anchor = CachedBeaconState(cfg, anchor_state)
         self.genesis_time = anchor_state.genesis_time
         self.genesis_validators_root = bytes(anchor_state.genesis_validators_root)
@@ -295,8 +323,15 @@ class BeaconChain:
         if block.slot <= fin.epoch * _p.SLOTS_PER_EPOCH:
             raise ValueError("block older than finalized checkpoint")
         parent_root = bytes(block.parent_root)
-        if not self.fork_choice.has_block(_hex(parent_root)):
+        parent_node = self.fork_choice.get_block(_hex(parent_root))
+        if parent_node is None:
             raise ValueError(f"unknown parent {parent_root.hex()}")
+        if parent_node.execution_status is ExecutionStatus.Invalid:
+            # the EL convicted the parent's payload: descendants are
+            # invalid by construction and must not re-enter the pipeline
+            raise ValueError(
+                f"parent {parent_root.hex()} payload was invalidated by the EL"
+            )
 
         pre_state = self.regen.get_pre_state(parent_root, block.slot)
         received_at = time.time()
@@ -307,10 +342,19 @@ class BeaconChain:
         loop = asyncio.get_running_loop()
 
         async def verify_payload():
+            from lodestar_tpu.execution.engine import (
+                ExecutePayloadStatus,
+                PayloadStatus,
+            )
+
             if self.execution_engine is None:
                 return None
             payload = getattr(block.body, "execution_payload", None)
             if payload is None:
+                return None
+            if bytes(payload.block_hash) == b"\x00" * 32:
+                # pre-transition block: the default (empty) payload never
+                # reaches an EL (spec: process_execution_payload skipped)
                 return None
             # spec validate_merge_block: the transition block's payload
             # parent must be a valid terminal PoW block (verified through
@@ -342,7 +386,31 @@ class BeaconChain:
                     ],
                     parent_beacon_block_root=bytes(block.parent_root),
                 )
-            res = await self.execution_engine.notify_new_payload(payload, **kwargs)
+            try:
+                res = await self.execution_engine.notify_new_payload(
+                    payload, **kwargs
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # an unreachable/erroring EL must DOWNGRADE the import to
+                # optimistic, not fail the block (sync/optimistic.md):
+                # the chain keeps following head and re-validates later
+                self._set_el_offline(True)
+                _log.warn(
+                    f"engine newPayload unavailable for block "
+                    f"0x{root.hex()[:8]} ({type(e).__name__}: {e}); "
+                    f"importing optimistically"
+                )
+                if self.metrics:
+                    self.metrics.lodestar.engine_new_payload_total.labels(
+                        status="engine_unavailable"
+                    ).inc()
+                return PayloadStatus(
+                    ExecutePayloadStatus.SYNCING,
+                    validation_error=f"engine unavailable: {e!r}",
+                )
+            self._set_el_offline(False)
             if self.metrics and res is not None:
                 self.metrics.lodestar.engine_new_payload_total.labels(
                     status=str(getattr(res.status, "value", res.status)).lower()
@@ -384,12 +452,39 @@ class BeaconChain:
             loop.run_in_executor(None, run_stf),
             verify_signatures(),
         )
-        if payload_res is not None and payload_res.status.value == "INVALID":
-            raise ValueError("execution payload invalid")
+        from lodestar_tpu.execution.engine import ExecutePayloadStatus
+
+        if (
+            payload_res is not None
+            and payload_res.status is ExecutePayloadStatus.INVALID
+        ):
+            # the rejected block never enters fork choice, but
+            # latestValidHash may convict already-imported (optimistic)
+            # ancestors: everything above it on the parent chain
+            lvh = payload_res.latest_valid_hash
+            if lvh is not None and self.fork_choice.has_block(_hex(parent_root)):
+                try:
+                    self.on_invalid_execution_payload(
+                        _hex(parent_root), _hex(bytes(lvh))
+                    )
+                except Exception as e:
+                    # head recompute hiccups must not mask the INVALID
+                    # verdict itself
+                    _log.warn(
+                        f"invalidation sweep after INVALID payload failed: "
+                        f"{type(e).__name__}: {e}"
+                    )
+            raise ExecutionPayloadInvalidError(
+                root,
+                bytes(lvh) if lvh is not None else None,
+                payload_res.validation_error,
+            )
         if not sigs_ok:
             raise ValueError("block signatures invalid")
 
-        self._import_block(signed_block, root, post_state, received_at)
+        self._import_block(
+            signed_block, root, post_state, received_at, payload_res
+        )
         if self.metrics:
             self.metrics.lodestar.block_import_seconds.observe(
                 time.perf_counter() - t_start
@@ -398,11 +493,33 @@ class BeaconChain:
             self.metrics.lodestar.state_cache_size.set(len(self.state_cache))
         return root
 
-    def _import_block(self, signed_block, root, post_state, received_at) -> None:
-        """importBlock.ts:46: persist, fork-choice, caches, events."""
+    def _import_block(
+        self, signed_block, root, post_state, received_at, payload_res=None
+    ) -> None:
+        """importBlock.ts:46: persist, fork-choice, caches, events.
+        ``payload_res`` is the EL's newPayload verdict (None when the
+        block carries no payload or no engine is attached): VALID
+        imports fully verified and de-optimisticizes the ancestor chain;
+        SYNCING/ACCEPTED (incl. the engine-unavailable downgrade)
+        imports optimistically."""
+        from lodestar_tpu.execution.engine import ExecutePayloadStatus
+
         block = signed_block.message
         self.db.block.put(root, signed_block)
         self.state_cache.add(root, post_state)
+
+        payload = getattr(block.body, "execution_payload", None)
+        payload_hash_hex = None
+        if payload is not None and bytes(payload.block_hash) != b"\x00" * 32:
+            payload_hash_hex = _hex(bytes(payload.block_hash))
+        if payload_hash_hex is None or payload_res is None:
+            # no payload, pre-transition, or no engine attached: the
+            # block is not subject to execution validity here
+            exec_status = ExecutionStatus.PreMerge
+        elif payload_res.status is ExecutePayloadStatus.VALID:
+            exec_status = ExecutionStatus.Valid
+        else:
+            exec_status = ExecutionStatus.Optimistic
 
         st = post_state.state
         epoch = block.slot // _p.SLOTS_PER_EPOCH
@@ -438,7 +555,8 @@ class BeaconChain:
                 unrealized_justified_root=_hex(bytes(uj.root)),
                 unrealized_finalized_epoch=uf.epoch,
                 unrealized_finalized_root=_hex(bytes(uf.root)),
-                execution_status=ExecutionStatus.PreMerge,
+                execution_payload_block_hash=payload_hash_hex,
+                execution_status=exec_status,
             ),
             block_delay_sec=block_delay,
             justified_checkpoint=CheckpointHex(
@@ -450,6 +568,13 @@ class BeaconChain:
                 _hex(bytes(st.finalized_checkpoint.root)),
             ),
         )
+        if exec_status is ExecutionStatus.Valid:
+            # the EL validated this payload, which vouches for the whole
+            # ancestor chain: de-flag any optimistically imported parents
+            self.fork_choice.on_valid_execution(_hex(root))
+        elif exec_status is ExecutionStatus.Optimistic:
+            if self.metrics:
+                self.metrics.lodestar.blocks_imported_optimistic_total.inc()
         # register the block's attestations as LMD votes (+ the validator
         # monitor's inclusion tracking, sharing the committee resolution)
         from lodestar_tpu.state_transition.block.phase0 import get_attesting_indices
@@ -519,6 +644,106 @@ class BeaconChain:
             fin_slot = fin_epoch * _p.SLOTS_PER_EPOCH
             self.seen_sync_committee_messages.prune(fin_slot)
             self.seen_sync_contributions.prune(fin_slot)
+
+    # ------------------------------------------------------------------
+    # optimistic sync (consensus-specs sync/optimistic.md; reference
+    # importBlock.ts + forkChoice executionStatus tracking)
+    # ------------------------------------------------------------------
+
+    def _set_el_offline(self, offline: bool) -> None:
+        self.el_offline = offline
+        if self.metrics:
+            self.metrics.lodestar.el_offline.set(1 if offline else 0)
+
+    def is_optimistic_root(self, root_hex: str) -> bool:
+        return self.fork_choice.is_optimistic(root_hex)
+
+    def is_optimistic_head(self) -> bool:
+        """True when the current head was imported without an EL verdict
+        — such a head is followable but must never be proposed on."""
+        return self.is_optimistic_root(_hex(self.head_root))
+
+    def on_invalid_execution_payload(
+        self, block_root_hex: str, latest_valid_hash_hex: Optional[str]
+    ) -> List[str]:
+        """An EL INVALID verdict anchored at ``block_root_hex``: prune
+        the invalidated subtree from head selection and move head off
+        it.  Returns the invalidated roots."""
+        invalidated = self.fork_choice.on_invalid_execution(
+            block_root_hex, latest_valid_hash_hex
+        )
+        if not invalidated:
+            return invalidated
+        if self.metrics:
+            self.metrics.lodestar.blocks_invalidated_total.inc(len(invalidated))
+        old_head_root = self.head_root
+        head = self.fork_choice.update_head()
+        self.head_root = bytes.fromhex(head.block_root[2:])
+        _log.warn(
+            f"EL invalidated {len(invalidated)} block(s) "
+            f"(latestValidHash={latest_valid_hash_hex}); head moved "
+            f"0x{old_head_root.hex()[:8]} -> {head.block_root[:10]}"
+        )
+        if self.head_root != old_head_root:
+            if self.metrics:
+                self.metrics.beacon.head_slot.set(head.slot)
+                self.metrics.beacon.reorgs_total.inc()
+            self._emit(ChainEvent.head, self.head_root)
+        return invalidated
+
+    async def notify_forkchoice_to_engine(self, payload_attributes=None):
+        """Per-slot/per-head engine_forkchoiceUpdated notification (the
+        reference's prepareExecutionPayload/notifyForkchoiceUpdate tick).
+        Consumes the EL's verdict — VALID de-optimisticizes the head
+        chain, INVALID prunes it — and NEVER raises on an unreachable
+        EL: the clock loop must survive a dead or lying EL.  Returns the
+        minted payloadId (attributes flows) or None."""
+        from lodestar_tpu.execution.engine import ExecutePayloadStatus
+
+        if self.execution_engine is None:
+            return None
+        head = self.fork_choice.get_head()
+        head_hash_hex = head.execution_payload_block_hash
+        if head_hash_hex is None:
+            return None  # pre-merge head: nothing to tell an EL yet
+
+        def _cp_payload_hash(cp_root_hex: str) -> bytes:
+            node = self.fork_choice.get_block(cp_root_hex)
+            h = node.execution_payload_block_hash if node is not None else None
+            return bytes.fromhex(h[2:]) if h is not None else b"\x00" * 32
+
+        store = self.fork_choice.store
+        try:
+            res = await self.execution_engine.notify_forkchoice_update(
+                bytes.fromhex(head_hash_hex[2:]),
+                _cp_payload_hash(store.justified.root),
+                _cp_payload_hash(store.finalized.root),
+                payload_attributes=payload_attributes,
+                # engine structure version follows the head's fork
+                # (V1/V2/V3); defaulting would pin capella+ chains to
+                # V1 and strict ELs reject the mismatch
+                fork=self._fork_config.fork_name_at_slot(head.slot),
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._set_el_offline(True)
+            _log.warn(
+                f"engine forkchoiceUpdated failed ({type(e).__name__}: {e}); "
+                f"keeping optimistic head"
+            )
+            return None
+        self._set_el_offline(False)
+        status = res.status
+        if status.status is ExecutePayloadStatus.INVALID:
+            lvh = status.latest_valid_hash
+            self.on_invalid_execution_payload(
+                head.block_root, _hex(bytes(lvh)) if lvh is not None else None
+            )
+            return None
+        if status.status is ExecutePayloadStatus.VALID:
+            self.fork_choice.on_valid_execution(head.block_root)
+        return res.payload_id
 
     # ------------------------------------------------------------------
 

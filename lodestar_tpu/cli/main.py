@@ -639,6 +639,20 @@ def run_beacon(args) -> int:
                     last_slot = slot
                     chain.fork_choice.update_time(max(slot, 0))
                     metrics.beacon.clock_slot.set(slot)
+                    if execution_engine is not None:
+                        # per-slot forkchoiceUpdated: keeps the EL's head
+                        # current and consumes its verdict (VALID
+                        # de-optimisticizes, INVALID prunes).  The chain
+                        # method never raises for a dead EL, but nothing
+                        # an EL sends may kill the clock loop either.
+                        try:
+                            await chain.notify_forkchoice_to_engine()
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception as e:
+                            log.warn(
+                                f"forkchoiceUpdated tick failed: {e!r}"
+                            )
                     st = chain.fork_choice.store
                     print(
                         json.dumps(

@@ -38,14 +38,26 @@ class PayloadStatus:
     validation_error: Optional[str] = None
 
 
+@dataclass
+class ForkchoiceUpdateResult:
+    """engine_forkchoiceUpdated response: the EL's verdict on the head
+    we pointed it at (VALID / SYNCING / INVALID-with-latestValidHash)
+    plus the payloadId minted when attributes were attached.  The chain
+    consumes the status for optimistic-sync bookkeeping — discarding it
+    was how PR 9's seam silently ate INVALID heads."""
+
+    status: PayloadStatus
+    payload_id: Optional[bytes] = None
+
+
 class ExecutionEngine(Protocol):
     async def notify_new_payload(
         self, payload, versioned_hashes=None, parent_beacon_block_root=None
     ) -> PayloadStatus: ...
     async def notify_forkchoice_update(
         self, head_block_hash: bytes, safe_block_hash: bytes,
-        finalized_block_hash: bytes, payload_attributes=None,
-    ) -> Optional[bytes]: ...
+        finalized_block_hash: bytes, payload_attributes=None, fork=None,
+    ) -> ForkchoiceUpdateResult: ...
     async def get_payload(self, payload_id: bytes): ...
 
 
@@ -91,34 +103,54 @@ def build_payload(
     return payload
 
 
-def build_dev_payload(cfg, state, transactions=(), fee_recipient=b"\x00" * 20):
-    """Payload valid for the next block on `state` (already advanced to the
-    block's slot): satisfies every process_execution_payload consistency
-    check (parent_hash / prev_randao / timestamp)."""
-    from lodestar_tpu.params import ACTIVE_PRESET as _p
+def dev_payload_attributes(
+    cfg, state, fee_recipient=b"\x00" * 20, parent_beacon_block_root=None
+):
+    """PayloadAttributes for the next block on ``state`` (already
+    advanced to the block's slot).  Shared by the local
+    ``build_dev_payload`` shortcut and the engine-backed production path
+    (forkchoiceUpdated-with-attributes → getPayload), so both build the
+    byte-identical payload and every process_execution_payload
+    consistency check (parent_hash / prev_randao / timestamp) holds."""
+    from lodestar_tpu.params import ACTIVE_PRESET as _p, ForkName
     from lodestar_tpu.types import fork_of_state
 
     fork = fork_of_state(state)
     epoch = state.slot // _p.SLOTS_PER_EPOCH
-    prev_randao = bytes(
-        state.randao_mixes[epoch % _p.EPOCHS_PER_HISTORICAL_VECTOR]
-    )
-    withdrawals = ()
+    attrs = {
+        "fork": fork,
+        "timestamp": state.genesis_time + state.slot * cfg.SECONDS_PER_SLOT,
+        "prev_randao": bytes(
+            state.randao_mixes[epoch % _p.EPOCHS_PER_HISTORICAL_VECTOR]
+        ),
+        "suggested_fee_recipient": bytes(fee_recipient),
+        "block_number": state.latest_execution_payload_header.block_number + 1,
+    }
     if hasattr(state, "next_withdrawal_index"):
         from lodestar_tpu.state_transition.block.capella import (
             get_expected_withdrawals,
         )
 
-        withdrawals = get_expected_withdrawals(state)
+        attrs["withdrawals"] = get_expected_withdrawals(state)
+    if fork is ForkName.eip4844 and parent_beacon_block_root is not None:
+        attrs["parent_beacon_block_root"] = bytes(parent_beacon_block_root)
+    return attrs
+
+
+def build_dev_payload(cfg, state, transactions=(), fee_recipient=b"\x00" * 20):
+    """Payload valid for the next block on `state` (already advanced to the
+    block's slot): satisfies every process_execution_payload consistency
+    check (parent_hash / prev_randao / timestamp)."""
+    attrs = dev_payload_attributes(cfg, state, fee_recipient=fee_recipient)
     return build_payload(
-        fork,
+        attrs["fork"],
         parent_hash=bytes(state.latest_execution_payload_header.block_hash),
-        timestamp=state.genesis_time + state.slot * cfg.SECONDS_PER_SLOT,
-        prev_randao=prev_randao,
-        withdrawals=withdrawals,
-        block_number=state.latest_execution_payload_header.block_number + 1,
+        timestamp=attrs["timestamp"],
+        prev_randao=attrs["prev_randao"],
+        withdrawals=attrs.get("withdrawals", ()),
+        block_number=attrs["block_number"],
         transactions=transactions,
-        fee_recipient=fee_recipient,
+        fee_recipient=attrs["suggested_fee_recipient"],
     )
 
 
@@ -152,15 +184,18 @@ class MockExecutionEngine:
 
     async def notify_forkchoice_update(
         self, head_block_hash, safe_block_hash, finalized_block_hash,
-        payload_attributes=None,
-    ) -> Optional[bytes]:
+        payload_attributes=None, fork=None,
+    ) -> ForkchoiceUpdateResult:
         self.head = head_block_hash
         self.finalized = finalized_block_hash
+        pid = None
         if payload_attributes is not None:
             pid = secrets.token_bytes(8)
             self._payloads[pid] = (head_block_hash, dict(payload_attributes))
-            return pid
-        return None
+        return ForkchoiceUpdateResult(
+            PayloadStatus(ExecutePayloadStatus.VALID, bytes(head_block_hash)),
+            pid,
+        )
 
     async def get_payload(self, payload_id: bytes):
         """Build the payload promised by a forkchoiceUpdated with
@@ -367,9 +402,11 @@ class HttpExecutionEngine(ReusedClientSession):
     async def notify_forkchoice_update(
         self, head_block_hash, safe_block_hash, finalized_block_hash,
         payload_attributes=None, fork=None,
-    ) -> Optional[bytes]:
+    ) -> ForkchoiceUpdateResult:
         """engine_forkchoiceUpdatedV{1,2,3} selected by ``fork`` (or the
-        fork tagged inside ``payload_attributes``; bellatrix default)."""
+        fork tagged inside ``payload_attributes``; bellatrix default).
+        Returns the EL's payloadStatus verdict (the optimistic-sync
+        input) alongside any minted payloadId."""
         from lodestar_tpu.execution import serde
         from lodestar_tpu.params import ForkName
 
@@ -390,9 +427,16 @@ class HttpExecutionEngine(ReusedClientSession):
         result = await self._rpc(
             f"engine_forkchoiceUpdatedV{version}", [fc_state, attrs_json]
         )
+        status_json = result.get("payloadStatus") or {}
+        lvh = status_json.get("latestValidHash")
+        status = PayloadStatus(
+            ExecutePayloadStatus(status_json.get("status", "SYNCING")),
+            bytes.fromhex(lvh[2:]) if lvh else None,
+            status_json.get("validationError"),
+        )
         pid = result.get("payloadId")
         if not pid:
-            return None
+            return ForkchoiceUpdateResult(status, None)
         pid_bytes = bytes.fromhex(pid[2:])
         self._payload_forks[pid_bytes] = fork
         # bounded: ids minted but never fetched (reorg past the slot,
@@ -401,7 +445,7 @@ class HttpExecutionEngine(ReusedClientSession):
         # practice
         while len(self._payload_forks) > 64:
             self._payload_forks.pop(next(iter(self._payload_forks)))
-        return pid_bytes
+        return ForkchoiceUpdateResult(status, pid_bytes)
 
     async def get_payload(self, payload_id: bytes, fork=None):
         """engine_getPayloadV{1,2,3} → the fork's SSZ ExecutionPayload.

@@ -1,0 +1,153 @@
+"""Engine-backed payload production under a slot-deadline watchdog
+(reference: produceBlockBody.ts getExecutionPayload + the
+prepareExecutionPayload timeout handling).
+
+A proposal has one slot interval (SECONDS_PER_SLOT / INTERVALS_PER_SLOT)
+to ship a block; an EL that stalls on ``engine_getPayload`` near that
+deadline must not take the proposal down with it.  The watchdog races
+forkchoiceUpdated-with-attributes and getPayload against the deadline
+with retry-then-abort semantics:
+
+* a QUICK failure (connection refused, JSON-RPC error) retries while
+  budget remains — a flapping EL gets its second chance;
+* a TIMEOUT burned the budget — abort immediately, no half-slot second
+  attempt against an EL that just proved it is hung;
+* every abort raises ``PayloadDeadlineError`` and increments the
+  distinct ``produce_payload_fallbacks_total`` metric, so the caller
+  falls back to a complete locally-built payload — never a half-built
+  block, never a stalled proposal loop.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+
+class PayloadDeadlineError(RuntimeError):
+    """The EL could not deliver a payload before the proposal deadline
+    (or refused to build one); the caller must fall back, not wait."""
+
+    def __init__(self, message: str, reason: str = "error"):
+        super().__init__(message)
+        self.reason = reason  # "deadline" | "error" | "refused"
+
+
+def _count_fallback(metrics, reason: str) -> None:
+    if metrics is not None:
+        metrics.produce_payload_fallbacks_total.labels(reason=reason).inc()
+
+
+async def get_payload_with_watchdog(
+    engine,
+    payload_id: bytes,
+    *,
+    deadline_s: float,
+    retries: int = 1,
+    metrics=None,
+    log: Optional[Callable[[str], None]] = None,
+):
+    """``engine_getPayload`` raced against ``deadline_s`` seconds.
+
+    Quick failures retry (up to ``retries`` extra attempts) while budget
+    remains; a timeout aborts outright.  Raises ``PayloadDeadlineError``
+    (with the fallback metric already counted) instead of ever returning
+    a partial result.
+    """
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + max(0.0, deadline_s)
+    last_err: Optional[BaseException] = None
+    reason = "deadline"
+    for attempt in range(retries + 1):
+        remaining = deadline - loop.time()
+        if remaining <= 0:
+            break
+        try:
+            return await asyncio.wait_for(
+                engine.get_payload(payload_id), timeout=remaining
+            )
+        except asyncio.CancelledError:
+            raise
+        except (asyncio.TimeoutError, TimeoutError) as e:
+            # the deadline itself fired: the EL is hung, a second
+            # attempt would just stall the proposal past the slot
+            last_err = e
+            reason = "deadline"
+            break
+        except Exception as e:
+            last_err = e
+            reason = "error"
+            if log is not None:
+                log(
+                    f"getPayload attempt {attempt + 1}/{retries + 1} "
+                    f"failed: {type(e).__name__}: {e}"
+                )
+    _count_fallback(metrics, reason)
+    raise PayloadDeadlineError(
+        f"getPayload missed the proposal deadline ({deadline_s:.2f}s): "
+        f"{type(last_err).__name__ if last_err else 'budget exhausted'}: "
+        f"{last_err}",
+        reason=reason,
+    ) from last_err
+
+
+async def produce_engine_payload(
+    engine,
+    *,
+    head_block_hash: bytes,
+    safe_block_hash: bytes,
+    finalized_block_hash: bytes,
+    attrs: dict,
+    deadline_s: float,
+    retries: int = 1,
+    metrics=None,
+    log: Optional[Callable[[str], None]] = None,
+):
+    """Full engine production flow under one deadline budget:
+    forkchoiceUpdated-with-attributes mints the payloadId, getPayload
+    fetches the built payload.  Any failure — transport, a non-VALID
+    head verdict, a withheld payloadId, a stall — funnels into
+    ``PayloadDeadlineError`` so the caller has exactly one fallback
+    seam."""
+    from lodestar_tpu.execution.engine import ExecutePayloadStatus
+
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + max(0.0, deadline_s)
+    try:
+        res = await asyncio.wait_for(
+            engine.notify_forkchoice_update(
+                head_block_hash,
+                safe_block_hash,
+                finalized_block_hash,
+                payload_attributes=attrs,
+            ),
+            timeout=max(0.01, deadline - loop.time()),
+        )
+    except asyncio.CancelledError:
+        raise
+    except (asyncio.TimeoutError, TimeoutError) as e:
+        _count_fallback(metrics, "deadline")
+        raise PayloadDeadlineError(
+            f"forkchoiceUpdated(attributes) missed the proposal deadline: {e}",
+            reason="deadline",
+        ) from e
+    except Exception as e:
+        _count_fallback(metrics, "error")
+        raise PayloadDeadlineError(
+            f"forkchoiceUpdated(attributes) failed: {type(e).__name__}: {e}",
+            reason="error",
+        ) from e
+    if res.status.status is not ExecutePayloadStatus.VALID or res.payload_id is None:
+        _count_fallback(metrics, "refused")
+        raise PayloadDeadlineError(
+            f"EL refused to build: status={res.status.status.value} "
+            f"payloadId={'minted' if res.payload_id else 'none'}",
+            reason="refused",
+        )
+    return await get_payload_with_watchdog(
+        engine,
+        res.payload_id,
+        deadline_s=deadline - loop.time(),
+        retries=retries,
+        metrics=metrics,
+        log=log,
+    )

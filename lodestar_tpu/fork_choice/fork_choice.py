@@ -243,6 +243,33 @@ class ForkChoice:
                 )
         self.head = None
 
+    def on_valid_execution(self, block_root: str) -> int:
+        """EL verdict VALID for ``block_root`` (newPayload or
+        forkchoiceUpdated): de-optimisticize it and its ancestor chain."""
+        flipped = self.proto_array.propagate_valid(block_root)
+        if flipped:
+            self.head = None
+        return flipped
+
+    def on_invalid_execution(
+        self, block_root: str, latest_valid_hash: Optional[str] = None
+    ) -> List[str]:
+        """EL verdict INVALID for ``block_root``: invalidate the subtree
+        above ``latest_valid_hash`` (sync/optimistic.md semantics) and
+        force the next head computation to route around it."""
+        invalidated = self.proto_array.propagate_invalid(
+            block_root, latest_valid_hash, self.store.current_slot
+        )
+        if invalidated:
+            self.head = None
+        return invalidated
+
+    def is_optimistic(self, block_root: str) -> bool:
+        return self.proto_array.is_optimistic(block_root)
+
+    def optimistic_roots(self) -> List[str]:
+        return self.proto_array.optimistic_roots()
+
     def prune(self, finalized_root: str) -> List[ProtoNode]:
         return self.proto_array.maybe_prune(finalized_root)
 

@@ -25,8 +25,21 @@ ZERO_ROOT_HEX = "0x" + "00" * 32
 
 
 class ExecutionStatus(str, Enum):
+    """Execution validity of a proto-node's payload (consensus-specs
+    sync/optimistic.md; reference protoArray executionStatus):
+
+    * ``Valid``      — the EL verified this payload (or a descendant's,
+      which implies the whole ancestor chain).
+    * ``Optimistic`` — imported without an EL verdict (SYNCING/ACCEPTED,
+      or the EL was unreachable); followable but never proposed on.
+    * ``PreMerge``   — no execution payload to verify (pre-merge block,
+      or a node running without an attached EL).
+    * ``Invalid``    — the EL rejected this payload or an ancestor's;
+      excluded from head selection forever.
+    """
+
     Valid = "Valid"
-    Syncing = "Syncing"
+    Optimistic = "Optimistic"
     PreMerge = "PreMerge"
     Invalid = "Invalid"
 
@@ -147,6 +160,15 @@ class ProtoArray:
             return
         node = ProtoNode(**vars(block))
         node.parent = self.indices.get(block.parent_root)
+        if (
+            node.parent is not None
+            and self.nodes[node.parent].execution_status
+            is ExecutionStatus.Invalid
+        ):
+            # descendants of an EL-invalidated payload are invalid by
+            # construction — a late arrival must not resurrect the
+            # pruned subtree into head eligibility
+            node.execution_status = ExecutionStatus.Invalid
         node_index = len(self.nodes)
         self.indices[block.block_root] = node_index
         self.nodes.append(node)
@@ -382,6 +404,142 @@ class ProtoArray:
                 return None
             node = self.nodes[node.parent]
         return node
+
+    # ------------------------------------------------------------------
+    # execution validity (consensus-specs sync/optimistic.md; reference
+    # protoArray validateLatestHash / invalidation propagation)
+    # ------------------------------------------------------------------
+
+    def is_optimistic(self, block_root: str) -> bool:
+        """True when the block was imported without an EL verdict.  A
+        node's own status is authoritative: Valid propagates to ancestors
+        on arrival, so a Valid node can never sit on an Optimistic one."""
+        node = self.get_node(block_root)
+        return node is not None and node.execution_status is ExecutionStatus.Optimistic
+
+    def optimistic_roots(self) -> List[str]:
+        return [
+            n.block_root
+            for n in self.nodes
+            if n.execution_status is ExecutionStatus.Optimistic
+        ]
+
+    def propagate_valid(self, block_root: str) -> int:
+        """An EL ``VALID`` verdict for ``block_root`` (from newPayload or
+        forkchoiceUpdated) de-optimisticizes the node AND its whole
+        ancestor chain — the EL can only validate a payload whose parent
+        it already validated.  Returns the number of nodes flipped."""
+        i = self.indices.get(block_root)
+        if i is None:
+            return 0
+        flipped = 0
+        node: Optional[ProtoNode] = self.nodes[i]
+        first = True
+        while node is not None:
+            status = node.execution_status
+            if status is ExecutionStatus.Invalid:
+                # a VALID verdict for a descendant of an invalidated
+                # block is an EL contradiction, not a state to record
+                raise ProtoArrayError(
+                    f"EL inconsistency: VALID verdict for descendant of "
+                    f"invalidated block {node.block_root}"
+                )
+            if status is ExecutionStatus.PreMerge:
+                break
+            if status is ExecutionStatus.Valid and not first:
+                break  # the chain below is already validated
+            if status is not ExecutionStatus.Valid:
+                node.execution_status = ExecutionStatus.Valid
+                flipped += 1
+            # a node inserted Valid can still sit on optimistic parents
+            # (its own newPayload verdict vouches for them): the start
+            # node never short-circuits the ancestor walk
+            first = False
+            node = self.nodes[node.parent] if node.parent is not None else None
+        return flipped
+
+    def propagate_invalid(
+        self,
+        block_root: str,
+        latest_valid_hash: Optional[str],
+        current_slot: int,
+    ) -> List[str]:
+        """An EL ``INVALID`` verdict for ``block_root``: invalidate it,
+        every ancestor above the ``latest_valid_hash`` payload (when the
+        hash identifies one on this chain), and every descendant of an
+        invalidated node, then refresh best-child/best-descendant so
+        head selection immediately routes around the dead subtree.
+
+        Already-``Valid``/``PreMerge`` ancestors are never flipped — an
+        EL claiming a previously validated payload is now invalid is
+        lying about history, and the validated prefix wins.  Returns the
+        invalidated roots (insertion order); an empty list means the
+        verdict did not touch any known node (unknown root, or the
+        target itself is the last-valid payload)."""
+        start = self.indices.get(block_root)
+        if start is None:
+            return []
+        bad: Set[int] = set()
+        idx: Optional[int] = start
+        while idx is not None:
+            node = self.nodes[idx]
+            if (
+                latest_valid_hash is not None
+                and node.execution_payload_block_hash == latest_valid_hash
+            ):
+                # the EL vouches for this payload and (implicitly) its
+                # ancestors — record that while we are here
+                self.propagate_valid(node.block_root)
+                break
+            if node.execution_status in (
+                ExecutionStatus.Valid,
+                ExecutionStatus.PreMerge,
+            ):
+                break
+            if node.block_root in (self.justified_root, self.finalized_root):
+                # never invalidate the checkpoint anchors: a lying EL
+                # whose lvh matches nothing must not convict the
+                # justified/finalized node — find_head would then
+                # silently serve an Invalid head (reference clients
+                # refuse the same way)
+                break
+            bad.add(idx)
+            if latest_valid_hash is None:
+                # no anchor: the spec scopes the verdict to the block
+                # itself (plus descendants, swept below)
+                break
+            idx = node.parent
+        if not bad:
+            return []
+
+        # forward sweep: children always sit after parents in insertion
+        # order, so one pass closes the descendant set
+        invalidated: List[str] = []
+        lo = min(bad)
+        for j in range(lo, len(self.nodes)):
+            node = self.nodes[j]
+            if j not in bad and (node.parent is None or node.parent not in bad):
+                continue
+            bad.add(j)
+            if node.execution_status is not ExecutionStatus.Invalid:
+                node.execution_status = ExecutionStatus.Invalid
+                invalidated.append(node.block_root)
+            node.best_child = None
+            node.best_descendant = None
+
+        # refresh best pointers; two backward passes: the first clears
+        # stale pointers into the dead subtree, the second lets the
+        # remaining viable children win the usual weight comparison
+        # (a single pass can leave a parent pointing nowhere when its
+        # stale best child is processed after a viable sibling)
+        for _ in range(2):
+            for node_index in range(len(self.nodes) - 1, -1, -1):
+                node = self.nodes[node_index]
+                if node.parent is not None:
+                    self._maybe_update_best_child_and_descendant(
+                        node.parent, node_index, current_slot
+                    )
+        return invalidated
 
     def maybe_prune(self, finalized_root: str) -> List[ProtoNode]:
         """Drop all nodes before the finalized one once past the threshold

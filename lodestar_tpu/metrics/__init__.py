@@ -174,6 +174,32 @@ class LodestarMetrics:
             "DepositEvent logs ingested by the deposit tracker",
             registry=registry,
         )
+        # optimistic sync + proposal robustness (ISSUE 12; panels in
+        # dashboards/lodestar_tpu_execution_el.json, pinned both
+        # directions by tests/test_dashboards.py)
+        self.blocks_imported_optimistic_total = Counter(
+            f"{ns}_blocks_imported_optimistic_total",
+            "Blocks imported without an EL verdict (SYNCING/ACCEPTED or "
+            "engine unreachable) — followable, never proposed on",
+            registry=registry,
+        )
+        self.blocks_invalidated_total = Counter(
+            f"{ns}_blocks_invalidated_total",
+            "Proto-array blocks invalidated by an EL INVALID verdict "
+            "(latestValidHash subtree pruning)",
+            registry=registry,
+        )
+        self.el_offline = Gauge(
+            f"{ns}_el_offline",
+            "1 while the last engine call failed at transport level",
+            registry=registry,
+        )
+        self.produce_payload_fallbacks_total = Counter(
+            f"{ns}_produce_payload_fallbacks_total",
+            "getPayload watchdog fallbacks to the locally-built payload",
+            ["reason"],  # deadline | error | refused
+            registry=registry,
+        )
         # block production (api/impl produceBlock role)
         self.blocks_produced_total = Counter(
             f"{ns}_blocks_produced_total",

@@ -1,0 +1,146 @@
+"""Adversarial execution layer: a deterministic per-method script over
+the mock EL (ROADMAP item 5b; docs/FAULTS.md style).
+
+``ElScript`` holds per-method directive queues; each engine call
+consumes the next directive for its method stem and an exhausted queue
+falls through to the honest ``MockExecutionEngine`` behavior — so a
+test scripts *exactly* the adversarial phase it wants (three SYNCING
+answers, one INVALID mid-chain, a stalled getPayload at the proposal
+deadline) and the EL behaves again afterwards.
+
+Directives are plain dicts; recognized keys:
+
+* ``status``            — answer this ExecutePayloadStatus instead of
+  validating (``newPayload`` / ``forkchoiceUpdated``); combine with
+  ``latest_valid_hash`` (bytes) and ``validation_error`` (str).
+* ``delay_s``           — await this long before answering (slow EL;
+  getPayload near the deadline).
+* ``error``             — raise instead of answering: an exception
+  instance or zero-arg factory (connection refused, EL crash).
+
+``ScriptedExecutionEngine`` is consumed two ways:
+
+* directly as a chain's ``execution_engine`` (in-process chaos tests on
+  the real import pipeline), or
+* behind ``MockElServer(engine=ScriptedExecutionEngine(...))`` so the
+  same script plays out over real HTTP against ``HttpExecutionEngine``
+  — statuses ride the JSON-RPC loop, delays stall the socket, and
+  raised ``RpcError``s become JSON-RPC error bodies.
+
+Transport-level storms (bare HTTP 500s, the retried shape) are scripted
+separately through the ``mock_el.engine`` fault seam in
+``mock_el_server.py`` — see docs/FAULTS.md.
+
+Everything is deterministic: queues, not probabilities.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from lodestar_tpu.execution.engine import (
+    ExecutePayloadStatus,
+    ForkchoiceUpdateResult,
+    MockExecutionEngine,
+    PayloadStatus,
+)
+
+# method stems a script can target
+NEW_PAYLOAD = "new_payload"
+FORKCHOICE = "forkchoice"
+GET_PAYLOAD = "get_payload"
+_STEMS = (NEW_PAYLOAD, FORKCHOICE, GET_PAYLOAD)
+
+
+class ElScript:
+    """Deterministic per-method adversarial directives (FIFO per stem)."""
+
+    def __init__(self, **per_method):
+        unknown = set(per_method) - set(_STEMS)
+        if unknown:
+            raise ValueError(f"unknown method stem(s): {sorted(unknown)}")
+        self._queues: Dict[str, List[dict]] = {
+            stem: list(per_method.get(stem, ())) for stem in _STEMS
+        }
+        self.consumed: Dict[str, List[dict]] = {stem: [] for stem in _STEMS}
+
+    def queue(self, stem: str, *directives: dict) -> "ElScript":
+        """Append directives for ``stem``; chainable."""
+        if stem not in _STEMS:
+            raise ValueError(f"unknown method stem {stem!r}")
+        self._queues[stem].extend(directives)
+        return self
+
+    def next(self, stem: str) -> Optional[dict]:
+        q = self._queues[stem]
+        if not q:
+            return None
+        d = q.pop(0)
+        self.consumed[stem].append(d)
+        return d
+
+    def pending(self, stem: str) -> int:
+        return len(self._queues[stem])
+
+
+def _scripted_status(d: dict) -> PayloadStatus:
+    lvh = d.get("latest_valid_hash")
+    return PayloadStatus(
+        ExecutePayloadStatus(d["status"]),
+        bytes(lvh) if lvh is not None else None,
+        d.get("validation_error"),
+    )
+
+
+class ScriptedExecutionEngine(MockExecutionEngine):
+    """MockExecutionEngine that answers its ``ElScript`` first.
+
+    Honest behavior (accept everything, build payloads) resumes per
+    method once its directive queue drains — the "EL recovers" phase of
+    a chaos scenario needs no re-wiring.
+    """
+
+    def __init__(self, script: Optional[ElScript] = None):
+        super().__init__()
+        self.script = script or ElScript()
+
+    async def _apply(self, stem: str) -> Optional[dict]:
+        d = self.script.next(stem)
+        if d is None:
+            return None
+        delay = d.get("delay_s")
+        if delay:
+            await asyncio.sleep(delay)
+        err = d.get("error")
+        if err is not None:
+            raise err() if callable(err) else err
+        return d
+
+    async def notify_new_payload(
+        self, payload, versioned_hashes=None, parent_beacon_block_root=None
+    ) -> PayloadStatus:
+        d = await self._apply(NEW_PAYLOAD)
+        if d is not None and "status" in d:
+            self.notified_payloads += 1
+            return _scripted_status(d)
+        return await super().notify_new_payload(
+            payload, versioned_hashes, parent_beacon_block_root
+        )
+
+    async def notify_forkchoice_update(
+        self, head_block_hash, safe_block_hash, finalized_block_hash,
+        payload_attributes=None, fork=None,
+    ) -> ForkchoiceUpdateResult:
+        d = await self._apply(FORKCHOICE)
+        if d is not None and "status" in d:
+            # a non-VALID verdict mints no payloadId (the EL cannot
+            # build on a head it does not recognize as valid)
+            return ForkchoiceUpdateResult(_scripted_status(d), None)
+        return await super().notify_forkchoice_update(
+            head_block_hash, safe_block_hash, finalized_block_hash,
+            payload_attributes, fork,
+        )
+
+    async def get_payload(self, payload_id: bytes):
+        await self._apply(GET_PAYLOAD)  # delay / error directives
+        return await super().get_payload(payload_id)

@@ -40,6 +40,7 @@ from lodestar_tpu.execution.engine import (
     SUPPORTED_ENGINE_METHODS,
     MockExecutionEngine,
 )
+from lodestar_tpu.testing import faults
 from lodestar_tpu.utils import get_logger
 
 # Engine API auth spec: iat must be within ±60 s of the EL's clock
@@ -164,6 +165,11 @@ class MockElServer:
         method = body.get("method", "")
         params = body.get("params", [])
         self.calls.append(method)
+        if method.startswith("engine_"):
+            # adversarial seam (docs/FAULTS.md): an armed fault here
+            # escapes the handler → aiohttp answers a bare HTTP 500,
+            # the retried-transport-error shape of an EL error storm
+            faults.fire("mock_el.engine", method=method)
         if method.startswith("engine_") and self.jwt_secret is not None:
             reason = self._jwt_rejection(request)
             if reason is not None:
@@ -208,7 +214,9 @@ class MockElServer:
             hashes = [serde.parse_data(h, 32) for h in params[1]]
             parent_root = serde.parse_data(params[2], 32)
             self.last_new_payload_extra = (hashes, parent_root)
-        status = self.engine.notify_new_payload_sync_status(payload)
+        # async dispatch so a scripted adversarial engine can stall or
+        # answer SYNCING/INVALID through the same HTTP loop
+        status = await self.engine.notify_new_payload(payload)
         return _payload_status_json(status)
 
     async def _rpc_engine__newPayloadV1(self, params):
@@ -231,13 +239,10 @@ class MockElServer:
             if attrs_json is not None
             else None
         )
-        pid = await self.engine.notify_forkchoice_update(head, safe, finalized, attrs)
+        res = await self.engine.notify_forkchoice_update(head, safe, finalized, attrs)
+        pid = res.payload_id
         return {
-            "payloadStatus": {
-                "status": "VALID",
-                "latestValidHash": serde.data(head),
-                "validationError": None,
-            },
+            "payloadStatus": _payload_status_json(res.status),
             "payloadId": serde.data(pid) if pid is not None else None,
         }
 

@@ -114,6 +114,7 @@ _SLOW_FILES = {
 # placed in exactly one list above or below — a compile-heavy suite can
 # no longer slip into tier-1 by simply not being listed anywhere.
 _FAST_FILES = {
+    "test_adversarial_el.py",
     "test_altair.py",
     "test_aot.py",
     "test_dashboards.py",
@@ -132,6 +133,7 @@ _FAST_FILES = {
     "test_native.py",
     "test_networks.py",
     "test_ops_tooling.py",
+    "test_optimistic_sync.py",
     "test_subnets.py",
 }
 

@@ -1,0 +1,309 @@
+"""Adversarial mock EL over real HTTP vs the chain+engine pipeline
+(ISSUE 12; ROADMAP item 5b): the scripted EL lies (SYNCING phases,
+INVALID-with-latestValidHash deep reorgs), stalls (slow getPayload at
+the proposal deadline) and storms (bare HTTP 500s through the
+``mock_el.engine`` fault seam) — and the chain degrades (optimistic
+import, watchdog fallback) instead of stalling.
+
+Also pins the engine-timeout retry carve-out from PR 7 (aiohttp timeout
+subclasses excluded from ``request_with_retry``) through the
+``execution.engine.http`` fault seam — previously undocumented-by-test.
+"""
+import asyncio
+from dataclasses import replace
+
+import pytest
+
+from lodestar_tpu.chain.chain import BeaconChain, ExecutionPayloadInvalidError
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.execution.engine import HttpExecutionEngine
+from lodestar_tpu.execution.payload_builder import (
+    PayloadDeadlineError,
+    produce_engine_payload,
+)
+from lodestar_tpu.metrics import Metrics
+from lodestar_tpu.params import ACTIVE_PRESET_NAME
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.testing import faults
+from lodestar_tpu.testing.adversarial_el import ElScript, ScriptedExecutionEngine
+from lodestar_tpu.testing.mock_el_server import MockElServer
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+cfg = replace(minimal_chain_config, ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=0)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.reset()
+
+
+class FakeTime:
+    def __init__(self, t0=0.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+
+class OkVerifier:
+    async def verify_signature_sets(self, sets, opts=None):
+        return True
+
+    async def close(self):
+        pass
+
+
+@pytest.fixture(scope="module")
+def dev_blocks():
+    dev = DevChain(cfg, 8, genesis_time=0)
+    blocks = []
+    for slot in range(1, 5):
+        b = dev.produce_block(slot)
+        dev.import_block(b, verify_signatures=False)
+        blocks.append(b)
+    return blocks
+
+
+def _phash(signed_block) -> bytes:
+    return bytes(signed_block.message.body.execution_payload.block_hash)
+
+
+_ANCHOR_BYTES = None
+
+
+def _anchor():
+    """init_dev_state costs ~4 s (interop keygen); pay it once per module
+    and hand each chain a fresh deserialized copy."""
+    global _ANCHOR_BYTES
+    from lodestar_tpu.db.beacon import _STATE_MF
+
+    if _ANCHOR_BYTES is None:
+        _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+        _ANCHOR_BYTES = _STATE_MF.serialize(anchor)
+    return _STATE_MF.deserialize(_ANCHOR_BYTES)
+
+
+async def _with_chain_over_http(fn, script=None):
+    """Real pipeline, real HTTP: BeaconChain -> HttpExecutionEngine ->
+    aiohttp -> MockElServer -> ScriptedExecutionEngine."""
+    scripted = ScriptedExecutionEngine(script or ElScript())
+    server = MockElServer(engine=scripted)
+    url = await server.start()
+    eng = HttpExecutionEngine(url)
+    anchor = _anchor()
+    ft = FakeTime(0.0)
+    chain = BeaconChain(
+        cfg, BeaconDb(), anchor, verifier=OkVerifier(),
+        execution_engine=eng, metrics=Metrics(),
+        clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=ft),
+    )
+    try:
+        return await fn(chain, ft, scripted, server)
+    finally:
+        await chain.close()
+        await server.close()
+
+
+async def _import(chain, ft, signed_block, timeout=20.0):
+    ft.t = signed_block.message.slot * cfg.SECONDS_PER_SLOT
+    return await asyncio.wait_for(chain.process_block(signed_block), timeout)
+
+
+def _counter(chain, name, labels=None):
+    return chain.metrics.registry.get_sample_value(name, labels or {}) or 0.0
+
+
+class TestAdversarialElOverHttp:
+    def test_syncing_phase_then_fcu_valid_recovers(self, dev_blocks):
+        async def go(chain, ft, scripted, server):
+            scripted.script.queue(
+                "new_payload", {"status": "SYNCING"}, {"status": "SYNCING"}
+            )
+            r1 = await _import(chain, ft, dev_blocks[0])
+            r2 = await _import(chain, ft, dev_blocks[1])
+            assert chain.head_root == r2  # followed head through the phase
+            assert chain.is_optimistic_head()
+            # EL catches up: the per-slot fcU tick consumes its VALID
+            # verdict over the same HTTP loop and de-flags the chain
+            await chain.notify_forkchoice_to_engine()
+            assert not chain.is_optimistic_head()
+            assert not chain.is_optimistic_root("0x" + r1.hex())
+            assert "engine_forkchoiceUpdatedV1" in server.calls
+
+        run(_with_chain_over_http(go))
+
+    def test_error_storm_degrades_to_optimistic_not_a_stall(self, dev_blocks):
+        async def go(chain, ft, scripted, server):
+            # every engine request 500s at the HTTP layer: the client
+            # retries (bounded), gives up, and the import DOWNGRADES
+            with faults.inject("mock_el.engine", times=99) as plan:
+                r1 = await _import(chain, ft, dev_blocks[0])
+            assert chain.head_root == r1
+            assert chain.is_optimistic_head()
+            assert chain.el_offline is True
+            assert plan.fired >= 3  # the bounded retry really ran
+            assert _counter(
+                chain, "lodestar_tpu_blocks_imported_optimistic_total"
+            ) == 1.0
+            # storm over: the next block validates and de-flags history
+            r2 = await _import(chain, ft, dev_blocks[1])
+            assert chain.head_root == r2
+            assert not chain.is_optimistic_head()
+            assert chain.el_offline is False
+
+        run(_with_chain_over_http(go))
+
+    def test_invalid_lvh_mid_chain_prunes_over_http(self, dev_blocks):
+        async def go(chain, ft, scripted, server):
+            r1 = await _import(chain, ft, dev_blocks[0])  # honest VALID
+            scripted.script.queue(
+                "new_payload", {"status": "SYNCING"}, {"status": "SYNCING"},
+                {"status": "INVALID", "latest_valid_hash": _phash(dev_blocks[0]),
+                 "validation_error": "adversarial: bad trie"},
+            )
+            await _import(chain, ft, dev_blocks[1])
+            await _import(chain, ft, dev_blocks[2])
+            with pytest.raises(ExecutionPayloadInvalidError) as ei:
+                await _import(chain, ft, dev_blocks[3])
+            # diagnostics crossed the HTTP loop intact
+            assert ei.value.latest_valid_hash == _phash(dev_blocks[0])
+            assert "adversarial: bad trie" in str(ei.value)
+            assert chain.head_root == r1  # optimistic subtree pruned
+            assert _counter(
+                chain, "lodestar_tpu_blocks_invalidated_total"
+            ) == 2.0
+
+        run(_with_chain_over_http(go))
+
+    def test_fcu_invalid_deep_reorg_over_http(self, dev_blocks):
+        async def go(chain, ft, scripted, server):
+            r1 = await _import(chain, ft, dev_blocks[0])
+            scripted.script.queue(
+                "new_payload",
+                {"status": "SYNCING"}, {"status": "SYNCING"},
+                {"status": "SYNCING"},
+            )
+            for b in dev_blocks[1:4]:
+                await _import(chain, ft, b)
+            assert chain.is_optimistic_head()
+            # the EL convicts the whole optimistic suffix in one fcU
+            scripted.script.queue("forkchoice", {
+                "status": "INVALID", "latest_valid_hash": _phash(dev_blocks[0]),
+            })
+            await chain.notify_forkchoice_to_engine()
+            assert chain.head_root == r1  # 3-deep reorg, no stall
+            assert _counter(
+                chain, "lodestar_tpu_blocks_invalidated_total"
+            ) == 3.0
+            assert not chain.is_optimistic_head()
+
+        run(_with_chain_over_http(go))
+
+    def test_slow_get_payload_at_deadline_trips_watchdog(self, dev_blocks):
+        async def go(chain, ft, scripted, server):
+            scripted.script.queue("get_payload", {"delay_s": 5.0})
+            m = chain.metrics.lodestar
+            from lodestar_tpu.execution.engine import dev_payload_attributes
+
+            st = chain.get_head_state().state
+            t0 = asyncio.get_running_loop().time()
+            with pytest.raises(PayloadDeadlineError) as ei:
+                await produce_engine_payload(
+                    chain.execution_engine,
+                    head_block_hash=bytes(
+                        st.latest_execution_payload_header.block_hash
+                    ),
+                    safe_block_hash=b"\x00" * 32,
+                    finalized_block_hash=b"\x00" * 32,
+                    attrs=dev_payload_attributes(cfg, st),
+                    deadline_s=0.4,
+                    metrics=m,
+                )
+            assert ei.value.reason == "deadline"
+            assert asyncio.get_running_loop().time() - t0 < 3.0
+            assert _counter(
+                chain,
+                "lodestar_tpu_produce_payload_fallbacks_total",
+                {"reason": "deadline"},
+            ) == 1.0
+
+        run(_with_chain_over_http(go))
+
+
+# ---------------------------------------------------------------------------
+# engine-timeout retry carve-out (satellite; PR 7 review fix, now pinned)
+# ---------------------------------------------------------------------------
+
+
+class TestTimeoutRetryCarveOut:
+    """aiohttp's timeout errors SUBCLASS ClientConnectionError; retrying
+    them would stretch a slot-deadlined engine call to ~3x the client
+    timeout against a hung EL.  The carve-out excludes them — driven
+    here through the ``execution.engine.http`` fault seam."""
+
+    async def _with_engine(self, fn):
+        server = MockElServer()
+        url = await server.start()
+        eng = HttpExecutionEngine(url)
+        try:
+            return await fn(eng, server)
+        finally:
+            await eng.close()
+            await server.close()
+
+    def test_aiohttp_timeout_subclass_fails_in_one_attempt(self):
+        import aiohttp
+
+        async def go(eng, server):
+            with faults.inject(
+                "execution.engine.http", times=1,
+                error=lambda: aiohttp.ServerTimeoutError("hung EL"),
+            ) as plan:
+                with pytest.raises(aiohttp.ServerTimeoutError):
+                    await eng.notify_forkchoice_update(
+                        b"\x01" * 32, b"\x01" * 32, b"\x01" * 32
+                    )
+            assert plan.calls == 1  # ONE attempt: no retry for timeouts
+            assert server.calls == []  # and the request never went out
+
+        run(self._with_engine(go))
+
+    def test_asyncio_timeout_also_fails_in_one_attempt(self):
+        async def go(eng, server):
+            with faults.inject(
+                "execution.engine.http", times=1,
+                error=lambda: asyncio.TimeoutError(),
+            ) as plan:
+                with pytest.raises(asyncio.TimeoutError):
+                    await eng.get_payload(b"\x00" * 8)
+            assert plan.calls == 1
+
+        run(self._with_engine(go))
+
+    def test_plain_connection_error_still_retries(self):
+        import aiohttp
+
+        async def go(eng, server):
+            with faults.inject(
+                "execution.engine.http", times=1,
+                error=lambda: aiohttp.ClientOSError("connection reset"),
+            ) as plan:
+                res = await eng.notify_forkchoice_update(
+                    b"\x02" * 32, b"\x02" * 32, b"\x02" * 32
+                )
+            assert res.status.status.value == "VALID"  # attempt 2 landed
+            assert plan.calls == 2
+            assert server.calls == ["engine_forkchoiceUpdatedV1"]
+
+        run(self._with_engine(go))

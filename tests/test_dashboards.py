@@ -109,14 +109,20 @@ def test_bls_pool_dashboard_pins_breaker_and_degradation_series():
     assert not unexported, f"pinned series not exported: {sorted(unexported)}"
 
 
-# Execution-seam series the EL dashboard must keep targeting (ISSUE 9):
-# a node on the wrong engine version for a fork, a flapping EL, or a
-# stalled deposit sync must be VISIBLE on the shipped board.
+# Execution-seam series the EL dashboard must keep targeting (ISSUE 9 +
+# ISSUE 12): a node on the wrong engine version for a fork, a flapping
+# EL, a stalled deposit sync, a chain running optimistically, or a
+# proposer living off the watchdog fallback must be VISIBLE on the
+# shipped board.
 _PINNED_EL_SERIES = {
     "lodestar_tpu_engine_rpc_seconds",
     "lodestar_tpu_engine_rpc_errors_total",
     "lodestar_tpu_eth1_sync_lag_blocks",
     "lodestar_tpu_eth1_deposit_events_total",
+    "lodestar_tpu_blocks_imported_optimistic_total",
+    "lodestar_tpu_blocks_invalidated_total",
+    "lodestar_tpu_el_offline",
+    "lodestar_tpu_produce_payload_fallbacks_total",
 }
 
 

@@ -175,10 +175,13 @@ class TestEngineE2E:
                 "suggested_fee_recipient": b"\x0a" * 20,
                 "withdrawals": _withdrawals(),
             }
-            pid = await eng.notify_forkchoice_update(
+            res = await eng.notify_forkchoice_update(
                 b"\x07" * 32, b"\x07" * 32, b"\x06" * 32,
                 payload_attributes=attrs,
             )
+            # the EL's verdict on our head rides back with the payloadId
+            assert res.status.status.value == "VALID"
+            pid = res.payload_id
             assert pid is not None
             payload = await eng.get_payload(pid)
             # what the client parsed is byte-identical to what the EL built
@@ -207,9 +210,12 @@ class TestEngineE2E:
                 "timestamp": 11,
                 "prev_randao": b"\x01" * 32,
             }
-            pid = await eng.notify_forkchoice_update(
-                b"\x01" * 32, b"\x01" * 32, b"\x01" * 32, payload_attributes=attrs
-            )
+            pid = (
+                await eng.notify_forkchoice_update(
+                    b"\x01" * 32, b"\x01" * 32, b"\x01" * 32,
+                    payload_attributes=attrs,
+                )
+            ).payload_id
             p1 = await eng.get_payload(pid)
             await eng.notify_new_payload(p1)
             assert server.calls[:3] == [
@@ -227,9 +233,12 @@ class TestEngineE2E:
                 "withdrawals": _withdrawals(1),
                 "parent_beacon_block_root": b"\x66" * 32,
             }
-            pid = await eng.notify_forkchoice_update(
-                b"\x02" * 32, b"\x02" * 32, b"\x02" * 32, payload_attributes=attrs
-            )
+            pid = (
+                await eng.notify_forkchoice_update(
+                    b"\x02" * 32, b"\x02" * 32, b"\x02" * 32,
+                    payload_attributes=attrs,
+                )
+            ).payload_id
             p3 = await eng.get_payload(pid)
             hashes = [b"\x01" + b"\x44" * 31]
             root = b"\x55" * 32

@@ -588,9 +588,10 @@ class TestEngineRetry:
         )
 
         async def go():
-            return await eng.notify_forkchoice_update(
+            res = await eng.notify_forkchoice_update(
                 b"\x00" * 32, b"\x00" * 32, b"\x00" * 32
             )
+            return res.payload_id
 
         assert run(go()) == b"\x00" * 7 + b"\x01"
         assert eng.posts == 3

@@ -87,10 +87,12 @@ class TestSecondProcessMockEl:
                         )
                     ],
                 }
-                pid = await eng.notify_forkchoice_update(
-                    b"\x0d" * 32, b"\x0d" * 32, b"\x0d" * 32,
-                    payload_attributes=attrs,
-                )
+                pid = (
+                    await eng.notify_forkchoice_update(
+                        b"\x0d" * 32, b"\x0d" * 32, b"\x0d" * 32,
+                        payload_attributes=attrs,
+                    )
+                ).payload_id
                 assert pid is not None
                 payload = await eng.get_payload(pid)
                 assert fork_of_payload(payload) is ForkName.capella

@@ -1,0 +1,684 @@
+"""Optimistic-sync state machine on the real chain+engine pipeline
+(consensus-specs sync/optimistic.md; reference importBlock.ts +
+proto-array execution-status tracking — ISSUE 12 tentpole).
+
+Layers covered:
+
+* proto-array: Optimistic insertion, VALID ancestor-chain propagation,
+  INVALID-with-latestValidHash subtree pruning and head re-routing;
+* BeaconChain: SYNCING/ACCEPTED and EL-offline imports stay on head
+  optimistically, later VALID de-flags, INVALID prunes + recovers onto
+  a competing branch — no scenario stalls the pipeline or leaves a
+  process_block waiter unsettled;
+* the getPayload proposal watchdog (retry-then-abort, distinct metric);
+* REST surfacing: /eth/v1/node/syncing el_offline/is_optimistic,
+  execution_optimistic on block responses, 503 on optimistic-head
+  production.
+"""
+import asyncio
+from dataclasses import replace
+
+import pytest
+
+from lodestar_tpu.chain.chain import BeaconChain, ExecutionPayloadInvalidError
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.execution.payload_builder import (
+    PayloadDeadlineError,
+    get_payload_with_watchdog,
+    produce_engine_payload,
+)
+from lodestar_tpu.fork_choice import (
+    CheckpointHex,
+    ExecutionStatus,
+    ForkChoice,
+    ForkChoiceStore,
+    ProtoArray,
+    ProtoArrayError,
+    ProtoBlock,
+    ZERO_ROOT_HEX,
+)
+from lodestar_tpu.metrics import Metrics
+from lodestar_tpu.params import ACTIVE_PRESET_NAME
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.testing.adversarial_el import ElScript, ScriptedExecutionEngine
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+cfg = replace(minimal_chain_config, ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=0)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# proto-array unit layer
+# ---------------------------------------------------------------------------
+
+
+def root(n: int) -> str:
+    return "0x" + (bytes([0xBB]) + n.to_bytes(31, "big")).hex()
+
+
+def payload_hash(n: int) -> str:
+    return "0x" + (bytes([0xEE]) + n.to_bytes(31, "big")).hex()
+
+
+def block(slot, blk_root, parent_root, status=ExecutionStatus.Optimistic,
+          exec_hash=None):
+    return ProtoBlock(
+        slot=slot, block_root=blk_root, parent_root=parent_root,
+        state_root=blk_root, target_root=blk_root,
+        justified_epoch=0, justified_root=ZERO_ROOT_HEX,
+        finalized_epoch=0, finalized_root=ZERO_ROOT_HEX,
+        unrealized_justified_epoch=0, unrealized_justified_root=ZERO_ROOT_HEX,
+        unrealized_finalized_epoch=0, unrealized_finalized_root=ZERO_ROOT_HEX,
+        execution_payload_block_hash=exec_hash,
+        execution_status=status,
+    )
+
+
+GENESIS = root(0)
+
+
+def make_fc(n=3):
+    """Genesis + a linear chain of n optimistic execution blocks."""
+    arr = ProtoArray.initialize(
+        block(0, GENESIS, root(0xFF), status=ExecutionStatus.PreMerge),
+        current_slot=1,
+    )
+    store = ForkChoiceStore(
+        current_slot=n + 1,
+        justified=CheckpointHex(0, GENESIS),
+        justified_balances=[32] * 4,
+        finalized=CheckpointHex(0, GENESIS),
+        unrealized_justified=CheckpointHex(0, GENESIS),
+        unrealized_finalized=CheckpointHex(0, GENESIS),
+    )
+    fc = ForkChoice(cfg, store, arr, proposer_boost_enabled=False)
+    for i in range(1, n + 1):
+        fc.on_block(
+            block(i, root(i), root(i - 1), exec_hash=payload_hash(i)),
+            99, fc.store.justified, fc.store.finalized,
+        )
+    return fc
+
+
+class TestProtoArrayExecutionStatus:
+    def test_optimistic_head_then_valid_propagates_down(self):
+        fc = make_fc(3)
+        assert fc.update_head().block_root == root(3)  # followable
+        assert fc.is_optimistic(root(1)) and fc.is_optimistic(root(3))
+        # VALID for the tip vouches for the whole ancestor chain
+        assert fc.on_valid_execution(root(3)) == 3
+        assert not any(fc.is_optimistic(root(i)) for i in (1, 2, 3))
+        # idempotent: nothing left to flip
+        assert fc.on_valid_execution(root(3)) == 0
+
+    def test_invalid_with_lvh_prunes_subtree_and_head_moves(self):
+        fc = make_fc(3)
+        fc.update_head()
+        invalidated = fc.on_invalid_execution(root(3), payload_hash(1))
+        assert set(invalidated) == {root(2), root(3)}
+        # the lvh anchor got validated while we were there
+        assert not fc.is_optimistic(root(1))
+        assert fc.get_block(root(1)).execution_status is ExecutionStatus.Valid
+        assert fc.update_head().block_root == root(1)
+
+    def test_invalid_without_lvh_scopes_to_target_and_descendants(self):
+        fc = make_fc(3)
+        invalidated = fc.on_invalid_execution(root(2), None)
+        assert set(invalidated) == {root(2), root(3)}
+        assert fc.is_optimistic(root(1))  # untouched, no anchor to judge it
+        assert fc.update_head().block_root == root(1)
+
+    def test_invalid_never_flips_validated_history(self):
+        fc = make_fc(3)
+        fc.on_valid_execution(root(2))  # 1 and 2 validated
+        invalidated = fc.on_invalid_execution(root(3), payload_hash(0xAA))
+        # unknown lvh: the sweep stops at the validated prefix
+        assert invalidated == [root(3)]
+        assert fc.get_block(root(2)).execution_status is ExecutionStatus.Valid
+        assert fc.update_head().block_root == root(2)
+
+    def test_valid_for_descendant_of_invalid_raises(self):
+        fc = make_fc(3)
+        fc.on_invalid_execution(root(2), None)
+        with pytest.raises(ProtoArrayError, match="inconsistency"):
+            fc.on_valid_execution(root(3))
+
+    def test_invalid_on_fork_reroutes_to_sibling(self):
+        fc = make_fc(2)
+        # sibling branch off root(1)
+        fc.on_block(
+            block(2, root(7), root(1), exec_hash=payload_hash(7)),
+            99, fc.store.justified, fc.store.finalized,
+        )
+        fc.on_invalid_execution(root(2), payload_hash(1))
+        head = fc.update_head()
+        assert head.block_root == root(7)  # the surviving sibling wins
+
+    def test_unknown_root_is_a_noop(self):
+        fc = make_fc(1)
+        assert fc.on_invalid_execution(root(0x55), None) == []
+        assert fc.on_valid_execution(root(0x55)) == 0
+
+    def test_late_child_of_invalidated_parent_stays_invalid(self):
+        """A block gossiped onto an invalidated parent after the sweep
+        must not resurrect the pruned subtree into head eligibility."""
+        fc = make_fc(2)
+        fc.on_invalid_execution(root(2), payload_hash(1))
+        fc.on_block(
+            block(3, root(9), root(2), exec_hash=payload_hash(9)),
+            99, fc.store.justified, fc.store.finalized,
+        )
+        assert fc.get_block(root(9)).execution_status is ExecutionStatus.Invalid
+        assert fc.update_head().block_root == root(1)
+
+    def test_lying_lvh_never_invalidates_the_justified_anchor(self):
+        """An lvh matching nothing on the chain stops the sweep at the
+        justified node — a lying EL must not convict the checkpoint
+        anchors (find_head would then serve an Invalid head)."""
+        fc = make_fc(3)
+        fc.proto_array.justified_root = root(1)  # as apply_score_changes sets
+        invalidated = fc.on_invalid_execution(root(3), payload_hash(0x77))
+        assert set(invalidated) == {root(2), root(3)}
+        anchor = fc.get_block(root(1))
+        assert anchor.execution_status is ExecutionStatus.Optimistic
+        assert fc.update_head().block_root == root(1)
+
+
+# ---------------------------------------------------------------------------
+# chain pipeline layer
+# ---------------------------------------------------------------------------
+
+
+class FakeTime:
+    def __init__(self, t0=0.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+
+class OkVerifier:
+    """BLS is not under test here: accept every signature set."""
+
+    async def verify_signature_sets(self, sets, opts=None):
+        return True
+
+    async def close(self):
+        pass
+
+
+_ANCHOR_BYTES = None
+
+
+def _anchor():
+    """init_dev_state costs ~4 s (interop keygen); pay it once per module
+    and hand each chain a fresh deserialized copy."""
+    global _ANCHOR_BYTES
+    from lodestar_tpu.db.beacon import _STATE_MF
+
+    if _ANCHOR_BYTES is None:
+        _, anchor = init_dev_state(cfg, 8, genesis_time=0)
+        _ANCHOR_BYTES = _STATE_MF.serialize(anchor)
+    return _STATE_MF.deserialize(_ANCHOR_BYTES)
+
+
+def make_chain(engine):
+    anchor = _anchor()
+    ft = FakeTime(0.0)
+    chain = BeaconChain(
+        cfg, BeaconDb(), anchor, verifier=OkVerifier(),
+        execution_engine=engine, metrics=Metrics(),
+        clock=LocalClock(0, cfg.SECONDS_PER_SLOT, now=ft),
+    )
+    return chain, ft
+
+
+@pytest.fixture(scope="module")
+def dev_blocks():
+    """Six linear merged blocks + a competing branch block at slot 7
+    whose parent is block 3 (the recovery fork after invalidation)."""
+    dev = DevChain(cfg, 8, genesis_time=0)
+    blocks = []
+    for slot in range(1, 7):
+        b = dev.produce_block(slot)
+        dev.import_block(b, verify_signatures=False)
+        blocks.append(b)
+    fork_dev = DevChain(cfg, 8, genesis_time=0)
+    for slot in range(1, 4):
+        fork_dev.import_block(
+            fork_dev.produce_block(slot), verify_signatures=False
+        )
+    fork_block = fork_dev.produce_block(7)  # parent: block 3, slots 4-6 empty
+    return blocks, fork_block
+
+
+def _phash(signed_block) -> bytes:
+    return bytes(signed_block.message.body.execution_payload.block_hash)
+
+
+def _root_of(signed_block) -> bytes:
+    m = signed_block.message
+    return type(m).hash_tree_root(m)
+
+
+async def _import(chain, ft, signed_block, timeout=20.0):
+    """Every waiter must settle — a stalled import IS the failure mode
+    this suite exists to rule out."""
+    ft.t = signed_block.message.slot * cfg.SECONDS_PER_SLOT
+    return await asyncio.wait_for(chain.process_block(signed_block), timeout)
+
+
+def _counter(chain, name, labels=None):
+    return chain.metrics.registry.get_sample_value(name, labels or {}) or 0.0
+
+
+class TestOptimisticImport:
+    def test_syncing_imports_optimistically_and_follows_head(self, dev_blocks):
+        blocks, _ = dev_blocks
+
+        async def go():
+            eng = ScriptedExecutionEngine(
+                ElScript(new_payload=[{"status": "SYNCING"}, {"status": "ACCEPTED"}])
+            )
+            chain, ft = make_chain(eng)
+            try:
+                r1 = await _import(chain, ft, blocks[0])
+                r2 = await _import(chain, ft, blocks[1])
+                # the chain keeps following head despite no EL verdict
+                assert chain.head_root == r2
+                assert chain.is_optimistic_root("0x" + r1.hex())
+                assert chain.is_optimistic_head()
+                assert _counter(
+                    chain, "lodestar_tpu_blocks_imported_optimistic_total"
+                ) == 2.0
+                # script drained: the next import is VALID and de-flags
+                # the whole ancestor chain (newPayload-driven validation)
+                r3 = await _import(chain, ft, blocks[2])
+                assert chain.head_root == r3
+                assert not chain.is_optimistic_head()
+                assert not chain.is_optimistic_root("0x" + r1.hex())
+            finally:
+                await chain.close()
+
+        run(go())
+
+    def test_el_offline_downgrades_to_optimistic_import(self, dev_blocks):
+        blocks, _ = dev_blocks
+
+        async def go():
+            eng = ScriptedExecutionEngine(
+                ElScript(new_payload=[
+                    {"error": lambda: ConnectionError("EL down")},
+                ])
+            )
+            chain, ft = make_chain(eng)
+            try:
+                r1 = await _import(chain, ft, blocks[0])
+                assert chain.head_root == r1  # import survived the dead EL
+                assert chain.is_optimistic_head()
+                assert chain.el_offline is True
+                assert _counter(chain, "lodestar_tpu_el_offline") == 1.0
+                # EL recovers: a VALID fcU verdict clears both flags
+                await chain.notify_forkchoice_to_engine()
+                assert chain.el_offline is False
+                assert not chain.is_optimistic_head()
+            finally:
+                await chain.close()
+
+        run(go())
+
+    def test_fcu_invalid_prunes_optimistic_subtree(self, dev_blocks):
+        blocks, _ = dev_blocks
+
+        async def go():
+            eng = ScriptedExecutionEngine(
+                ElScript(new_payload=[{}, {"status": "SYNCING"},
+                                      {"status": "SYNCING"}])
+            )
+            chain, ft = make_chain(eng)
+            try:
+                r1 = await _import(chain, ft, blocks[0])  # honest: VALID
+                await _import(chain, ft, blocks[1])       # optimistic
+                r3 = await _import(chain, ft, blocks[2])  # optimistic
+                assert chain.head_root == r3
+                # deep reorg via forkchoiceUpdated: the EL convicts the
+                # optimistic suffix down to block 1
+                eng.script.queue("forkchoice", {
+                    "status": "INVALID",
+                    "latest_valid_hash": _phash(blocks[0]),
+                })
+                pid = await chain.notify_forkchoice_to_engine()
+                assert pid is None
+                assert chain.head_root == r1  # head moved off the subtree
+                assert _counter(
+                    chain, "lodestar_tpu_blocks_invalidated_total"
+                ) == 2.0
+                # a block building on the invalidated tip is refused at
+                # the pipeline door, not re-imported
+                with pytest.raises(ValueError, match="invalidated"):
+                    await _import(chain, ft, blocks[3])
+            finally:
+                await chain.close()
+
+        run(go())
+
+    def test_fcu_tick_selects_engine_version_by_head_fork(self):
+        """The per-slot fcU tick must carry the head's fork: a capella
+        chain speaks engine_forkchoiceUpdatedV2, not V1 (strict ELs
+        reject the mismatch and the tick would latch el_offline)."""
+        from lodestar_tpu.params import ForkName
+
+        cfg_cap = replace(cfg, CAPELLA_FORK_EPOCH=0)
+
+        class RecordingEngine(ScriptedExecutionEngine):
+            def __init__(self):
+                super().__init__()
+                self.fcu_forks = []
+
+            async def notify_forkchoice_update(
+                self, h, s, f, payload_attributes=None, fork=None
+            ):
+                self.fcu_forks.append(fork)
+                return await super().notify_forkchoice_update(
+                    h, s, f, payload_attributes, fork
+                )
+
+        async def go():
+            eng = RecordingEngine()
+            _, anchor = init_dev_state(cfg_cap, 8, genesis_time=0)
+            ft = FakeTime(0.0)
+            chain = BeaconChain(
+                cfg_cap, BeaconDb(), anchor, verifier=OkVerifier(),
+                execution_engine=eng,
+                clock=LocalClock(0, cfg_cap.SECONDS_PER_SLOT, now=ft),
+            )
+            try:
+                dev = DevChain(cfg_cap, 8, genesis_time=0)
+                b1 = dev.produce_block(1)
+                dev.import_block(b1, verify_signatures=False)
+                ft.t = cfg_cap.SECONDS_PER_SLOT
+                await chain.process_block(b1)
+                await chain.notify_forkchoice_to_engine()
+                assert eng.fcu_forks[-1] is ForkName.capella
+            finally:
+                await chain.close()
+
+        run(go())
+
+
+class TestInvalidationAndRecovery:
+    def test_invalid_newpayload_prunes_then_chain_recovers(self, dev_blocks):
+        blocks, fork_block = dev_blocks
+
+        async def go():
+            eng = ScriptedExecutionEngine(ElScript(new_payload=[
+                {}, {}, {},                      # blocks 1-3 honest VALID
+                {"status": "SYNCING"},           # block 4 optimistic
+                {"status": "SYNCING"},           # block 5 optimistic
+                {"status": "INVALID",            # block 6: convicts 4+5 too
+                 "latest_valid_hash": _phash(blocks[2]),
+                 "validation_error": "bad state root in payload"},
+            ]))
+            chain, ft = make_chain(eng)
+            try:
+                roots = [await _import(chain, ft, b) for b in blocks[:5]]
+                assert chain.head_root == roots[4]
+                with pytest.raises(ExecutionPayloadInvalidError) as ei:
+                    await _import(chain, ft, blocks[5])
+                # the EL's diagnostics surface in the typed error
+                assert ei.value.latest_valid_hash == _phash(blocks[2])
+                assert "bad state root" in str(ei.value)
+                # descendants of the last valid payload are gone from
+                # head selection; head moved off the invalid subtree
+                assert chain.head_root == roots[2]
+                assert _counter(
+                    chain, "lodestar_tpu_blocks_invalidated_total"
+                ) == 2.0
+                fc = chain.fork_choice
+                assert fc.get_block(
+                    "0x" + roots[3].hex()
+                ).execution_status is ExecutionStatus.Invalid
+                # recovery: a competing branch on the valid prefix wins
+                # head (script drained -> honest VALID again)
+                fork_root = await _import(chain, ft, fork_block)
+                assert chain.head_root == fork_root
+                assert not chain.is_optimistic_head()
+            finally:
+                await chain.close()
+
+        run(go())
+
+    def test_rejected_block_queue_stays_live(self, dev_blocks):
+        """An INVALID verdict fails ONE import; the queue keeps serving
+        (no stalled clock loop, no unsettled waiters)."""
+        blocks, _ = dev_blocks
+
+        async def go():
+            eng = ScriptedExecutionEngine(ElScript(new_payload=[
+                {"status": "INVALID", "latest_valid_hash": None},
+            ]))
+            chain, ft = make_chain(eng)
+            try:
+                with pytest.raises(ExecutionPayloadInvalidError):
+                    await _import(chain, ft, blocks[0])
+                # same block again, EL honest now: imports cleanly
+                r1 = await _import(chain, ft, blocks[0])
+                assert chain.head_root == r1
+                assert not chain.is_optimistic_head()
+            finally:
+                await chain.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# getPayload proposal watchdog
+# ---------------------------------------------------------------------------
+
+
+def _metrics():
+    return Metrics()
+
+
+class TestProposalWatchdog:
+    def _mint(self, eng, dev_state):
+        from lodestar_tpu.execution.engine import dev_payload_attributes
+
+        return dev_payload_attributes(cfg, dev_state)
+
+    def test_stalled_get_payload_aborts_at_deadline_with_metric(self):
+        async def go():
+            m = _metrics()
+            eng = ScriptedExecutionEngine(
+                ElScript(get_payload=[{"delay_s": 5.0}])
+            )
+            anchor = _anchor()
+            attrs = self._mint(eng, anchor)
+            t0 = asyncio.get_running_loop().time()
+            with pytest.raises(PayloadDeadlineError) as ei:
+                await produce_engine_payload(
+                    eng,
+                    head_block_hash=bytes(
+                        anchor.latest_execution_payload_header.block_hash
+                    ),
+                    safe_block_hash=b"\x00" * 32,
+                    finalized_block_hash=b"\x00" * 32,
+                    attrs=attrs,
+                    deadline_s=0.3,
+                    metrics=m.lodestar,
+                )
+            elapsed = asyncio.get_running_loop().time() - t0
+            assert ei.value.reason == "deadline"
+            assert elapsed < 2.0  # aborted at the deadline, not the stall
+            assert m.registry.get_sample_value(
+                "lodestar_tpu_produce_payload_fallbacks_total",
+                {"reason": "deadline"},
+            ) == 1.0
+
+        run(go())
+
+    def test_quick_error_retries_then_banks_the_payload(self):
+        async def go():
+            eng = ScriptedExecutionEngine(
+                ElScript(get_payload=[{"error": RuntimeError("hiccup")}])
+            )
+            anchor = _anchor()
+            res = await eng.notify_forkchoice_update(
+                b"\x01" * 32, b"\x01" * 32, b"\x01" * 32,
+                payload_attributes=self._mint(eng, anchor),
+            )
+            payload = await get_payload_with_watchdog(
+                eng, res.payload_id, deadline_s=5.0, retries=1
+            )
+            assert payload is not None  # retry-then-succeed, not abort
+
+        run(go())
+
+    def test_el_refusing_to_build_counts_distinctly(self):
+        async def go():
+            m = _metrics()
+            eng = ScriptedExecutionEngine(
+                ElScript(forkchoice=[{"status": "SYNCING"}])
+            )
+            anchor = _anchor()
+            with pytest.raises(PayloadDeadlineError) as ei:
+                await produce_engine_payload(
+                    eng,
+                    head_block_hash=b"\x01" * 32,
+                    safe_block_hash=b"\x01" * 32,
+                    finalized_block_hash=b"\x00" * 32,
+                    attrs=self._mint(eng, anchor),
+                    deadline_s=1.0,
+                    metrics=m.lodestar,
+                )
+            assert ei.value.reason == "refused"
+            assert m.registry.get_sample_value(
+                "lodestar_tpu_produce_payload_fallbacks_total",
+                {"reason": "refused"},
+            ) == 1.0
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# REST surfacing (beacon-API optimistic fields)
+# ---------------------------------------------------------------------------
+
+
+class TestRestSurfacing:
+    def test_syncing_blocks_and_production_reflect_optimism(self, dev_blocks):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from lodestar_tpu.api.server import BeaconRestApiServer
+
+        blocks, _ = dev_blocks
+
+        async def go():
+            eng = ScriptedExecutionEngine(ElScript(
+                new_payload=[{"status": "SYNCING"}],
+            ))
+            chain, ft = make_chain(eng)
+            api = BeaconRestApiServer(chain, chain.db)
+            client = TestClient(TestServer(api.app))
+            await client.start_server()
+            try:
+                r1 = await _import(chain, ft, blocks[0])
+                resp = await client.get("/eth/v1/node/syncing")
+                data = (await resp.json())["data"]
+                assert data["is_optimistic"] is True
+                assert data["el_offline"] is False  # reachable, just SYNCING
+                # optimistic head: production must refuse (503), both routes
+                assert (
+                    await client.get("/eth/v2/validator/blocks/2")
+                ).status == 503
+                assert (
+                    await client.get("/eth/v1/validator/blinded_blocks/2")
+                ).status == 503
+                # block + debug responses carry execution_optimistic
+                body = await (
+                    await client.get("/eth/v2/beacon/blocks/head")
+                ).json()
+                assert body["execution_optimistic"] is True
+                heads = await (
+                    await client.get("/eth/v1/debug/beacon/heads")
+                ).json()
+                assert any(h["execution_optimistic"] for h in heads["data"])
+                # per-resource semantics: the head STATE is optimistic,
+                # the finalized (anchor) state is not
+                body = await (
+                    await client.get("/eth/v1/beacon/states/head/root")
+                ).json()
+                assert body["execution_optimistic"] is True
+                body = await (
+                    await client.get("/eth/v1/beacon/states/finalized/root")
+                ).json()
+                assert body["execution_optimistic"] is False
+                # EL validates via fcU -> everything flips back
+                await chain.notify_forkchoice_to_engine()
+                data = (await (
+                    await client.get("/eth/v1/node/syncing")
+                ).json())["data"]
+                assert data["is_optimistic"] is False
+                body = await (
+                    await client.get("/eth/v2/beacon/blocks/head")
+                ).json()
+                assert body["execution_optimistic"] is False
+                assert r1 == chain.head_root
+            finally:
+                await client.close()
+                await chain.close()
+
+        run(go())
+
+    def test_production_falls_back_when_get_payload_stalls(self, dev_blocks):
+        """REST block production survives a stalling EL: the watchdog
+        aborts, the distinct metric counts, and the served block carries
+        the complete locally-built payload."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from lodestar_tpu.api.server import BeaconRestApiServer
+
+        async def go():
+            eng = ScriptedExecutionEngine(
+                ElScript(get_payload=[{"delay_s": 5.0}])
+            )
+            chain, ft = make_chain(eng)
+            # just before the slot-1 attestation deadline: tiny budget
+            ft.t = 1 * cfg.SECONDS_PER_SLOT + 1.8
+            api = BeaconRestApiServer(chain, chain.db)
+            client = TestClient(TestServer(api.app))
+            await client.start_server()
+            try:
+                resp = await asyncio.wait_for(
+                    client.get("/eth/v2/validator/blocks/1"), 15.0
+                )
+                assert resp.status == 200
+                body = await resp.json()
+                payload = body["data"]["body"]["execution_payload"]
+                # a complete payload, linked to the head EL block
+                st = chain.get_head_state().state
+                assert payload["parent_hash"] == (
+                    "0x"
+                    + bytes(
+                        st.latest_execution_payload_header.block_hash
+                    ).hex()
+                )
+                assert _counter(
+                    chain,
+                    "lodestar_tpu_produce_payload_fallbacks_total",
+                    {"reason": "deadline"},
+                ) == 1.0
+            finally:
+                await client.close()
+                await chain.close()
+
+        run(go())
