@@ -30,6 +30,18 @@ engagement counters are exported through BlsPoolMetrics.
 The "pool" is the device itself: jobs run one at a time on the chip via an
 asyncio lock (XLA serializes kernels anyway), with the batching window
 amortizing dispatch + padded-bucket compile reuse (16/32/64/128).
+
+Ownership discipline (mechanically enforced by lodelint's
+``pool-ownership`` rule, docs/LINT.md): pool state (`_buffer`,
+`_buffer_sigs`, `_encoding`, `_flush_handle`, `_tasks`) is owned by the
+event loop — callables handed to ``run_in_executor`` (`_encode_host`,
+`_execute_device`, `_each_device`, `_host_verify_pack`) never mutate it;
+the encode-stage token is released only through the test-and-clear guard
+(``if owner["encode"]: owner["encode"] = False; self._release_encode()``)
+with no await inside the guard.  Job widths are quantized through
+``buckets.pool_bucket`` before any dispatch or ``bucket=`` hand-off, so
+every program shape the pool can mint is in the AOT warm registry
+(enforced by ``retrace-hazard``).
 """
 from __future__ import annotations
 

@@ -10,6 +10,13 @@ Three metric groups like the reference's createMetrics (metrics.ts:14):
 plus the per-validator duty tracker (validator_monitor.py mirroring
 createValidatorMonitor, metrics/validatorMonitor.ts:165) and the HTTP
 exposition server (server.py, metrics/server/).
+
+Registration contract (mechanically enforced by lodelint's
+``metric-label-drift`` rule, docs/LINT.md): every metric name is
+constructed at exactly ONE site repo-wide, and every call site passes
+exactly the declared label set — a drifted ``.labels(...)`` or a bare
+``.inc()`` on a labeled family raises ``ValueError`` at runtime, usually
+inside the error handler the metric was meant to make visible.
 """
 from __future__ import annotations
 

@@ -33,10 +33,27 @@ Two layers:
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from .core import annotate_parents, dotted_name, parse_suppressions
+from .core import annotate_parents, dotted_name, enclosing_loop, parse_suppressions, unparse
 from .effects import direct_effects, module_effect_context
+
+# --- v3 whole-program vocabulary (rules_program.py consumes these) ---------
+# the bucket-quantizer functions of ops/bls12_381/buckets.py: a width that
+# flows through one of these is provably an AOT compile rung
+QUANT_FUNCS = {"bucket_size", "pool_bucket", "align_down"}
+# prometheus metric constructors (canonical, import-resolved)
+_PROM_TYPES = {
+    f"prometheus_client.{t}" for t in ("Counter", "Gauge", "Histogram", "Summary")
+}
+_METRIC_OPS = {"inc", "dec", "observe", "set"}
+# identifier segments that name a jit-program batch width.  Locals match
+# the full set; parameter seeding (rules_program) deliberately uses only
+# bucket|width — `size` params are everywhere in SSZ code and are not on
+# the dispatch path.
+WIDTH_LOCAL_RE = re.compile(r"(?:^|_)(size|bucket|width)(?:_|$)")
+WIDTH_PARAM_RE = re.compile(r"(?:^|_)(bucket|width)(?:_|$)")
 
 # call wrappers that schedule/await the coroutine they are handed — a
 # known-async call inside one of these is NOT an unawaited coroutine
@@ -114,6 +131,115 @@ def _expr_type_refs(
     return []
 
 
+# ---------------------------------------------------------------------------
+# width/argument provenance tags (retrace-hazard raw material)
+# ---------------------------------------------------------------------------
+#
+# A *tag* is a small JSON value describing where an expression's value
+# provably comes from:
+#
+#   ["quant"]          a bucket-quantizer call (QUANT_FUNCS)
+#   ["const", n]       an int literal
+#   ["none"]           literal None (callee default applies)
+#   ["param", name]    the enclosing function's parameter `name`
+#   ["all", [t, ...]]  every branch/operand must satisfy (IfExp/BoolOp/min/max)
+#   ["rawlen", detail] a len(...) call — PROVABLY a per-call size, the
+#                      canonical retrace storm (one program per distinct
+#                      input size); distinguishable from tensor args, so
+#                      dispatch sites can judge positional args too
+#   ["star"]           a *starred positional (alignment unknown from here on)
+#   ["other", detail]  anything else — not provable
+#
+# rules_program.py closes ["param", ...] over the call graph (every
+# resolved caller must pass a quantized value) and judges ["const", n]
+# against the rung set parsed from ops/bls12_381/buckets.py.
+
+
+def _width_tag(node, canon, params, local_tags) -> list:
+    if node is None:
+        return ["none"]
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return ["none"]
+        if isinstance(node.value, int) and not isinstance(node.value, bool):
+            return ["const", node.value]
+        return ["other", repr(node.value)[:60]]
+    if isinstance(node, ast.Name):
+        if node.id in local_tags:
+            return local_tags[node.id]
+        if node.id in params:
+            return ["param", node.id]
+        return ["other", node.id]
+    if isinstance(node, ast.Call):
+        dn = canon(dotted_name(node.func)) or ""
+        last = dn.rsplit(".", 1)[-1]
+        if last in QUANT_FUNCS:
+            return ["quant"]
+        if dn == "len" and len(node.args) == 1:
+            # the line of the len() itself rides along: the root site for
+            # suppression + binding/dispatch dedup in retrace-hazard
+            return ["rawlen", (unparse(node) or "len(...)")[:60], node.lineno]
+        if last in ("min", "max") and node.args and not node.keywords:
+            return ["all", [_width_tag(a, canon, params, local_tags)
+                            for a in node.args]]
+        return ["other", (unparse(node) or "call")[:60]]
+    if isinstance(node, ast.IfExp):
+        return ["all", [_width_tag(node.body, canon, params, local_tags),
+                        _width_tag(node.orelse, canon, params, local_tags)]]
+    if isinstance(node, ast.BoolOp):
+        return ["all", [_width_tag(v, canon, params, local_tags)
+                        for v in node.values]]
+    if isinstance(node, ast.Await):
+        return _width_tag(node.value, canon, params, local_tags)
+    return ["other", (unparse(node) or type(node).__name__)[:60]]
+
+
+def _arg_record(node, canon, params, local_tags) -> dict:
+    """Compact provenance record for one call argument: a width tag plus
+    the dotted reference when the arg IS a plain name/attribute chain
+    (how run_in_executor/Thread callables are recognized)."""
+    rec: Dict[str, object] = {"tag": _width_tag(node, canon, params, local_tags)}
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        ref = dotted_name(node)
+        if ref:
+            rec["ref"] = ref
+    return rec
+
+
+def _const_str(node, str_env: Dict[str, str]) -> Optional[str]:
+    """Statically render a str constant or an f-string whose interpolated
+    names are known local str constants (metric-name resolution)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif (
+                isinstance(v, ast.FormattedValue)
+                and isinstance(v.value, ast.Name)
+                and v.value.id in str_env
+            ):
+                parts.append(str_env[v.value.id])
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+def _label_list(node) -> Optional[List[str]]:
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
 def walk_own(func: ast.AST) -> Iterable[ast.AST]:
     """Walk a function's body excluding nested def/lambda subtrees (their
     effects/calls belong to the nested function, which gets its own graph
@@ -174,6 +300,12 @@ class _Extractor(ast.NodeVisitor):
         self.module_vars: Dict[str, List[str]] = {}
         self.scope: List[Tuple[str, str]] = []  # (kind, name)
         self.ctx = None  # module_effect_context, set in extract_summary
+        # v3 whole-program raw material
+        self.module_consts: Dict[str, List[int]] = {}  # int / tuple-of-int
+        self.module_strs: Dict[str, str] = {}
+        self.jit_wrappers: List[str] = []  # names bound to registry.jitted()
+        self.metric_defs: List[dict] = []
+        self.release_defs: List[str] = []  # stage-release method short names
 
     # -- imports ------------------------------------------------------
 
@@ -260,10 +392,37 @@ class _Extractor(ast.NodeVisitor):
             refs = _ann_refs(arg.annotation)
             if refs:
                 params[arg.arg] = refs
+        arg_names = [a.arg for a in all_args]
+        param_set = set(arg_names)
+        canon = self.ctx.canon
+        # default-value tags for the trailing positional params + kwonly
+        # (a caller that omits a width param gets the default's provenance)
+        arg_defaults: Dict[str, list] = {}
+        pos_args = list(node.args.posonlyargs) + list(node.args.args)
+        for a, d in zip(pos_args[len(pos_args) - len(node.args.defaults):],
+                        node.args.defaults):
+            arg_defaults[a.arg] = _width_tag(d, canon, param_set, {})
+        for a, d in zip(node.args.kwonlyargs, node.args.kw_defaults):
+            if d is not None:
+                arg_defaults[a.arg] = _width_tag(d, canon, param_set, {})
 
         local_types: Dict[str, List[str]] = {}
         globals_decl: Set[str] = set()
+        local_tags: Dict[str, list] = {}  # last width-provenance per local
+        width_locals: List[dict] = []
+        str_env: Dict[str, str] = dict(self.module_strs)
+        jit_aliases: Set[str] = set()
         own = list(walk_own(node))
+
+        def _jit_ref(value) -> bool:
+            if isinstance(value, ast.Name):
+                return value.id in self.jit_wrappers or value.id in jit_aliases
+            if isinstance(value, ast.IfExp):
+                return _jit_ref(value.body) and _jit_ref(value.orelse)
+            if isinstance(value, ast.BoolOp):
+                return all(_jit_ref(v) for v in value.values)
+            return False
+
         # two passes: types first (assignment order approximation), then
         # calls/effects so `v = Foo(); v.m()` resolves within one body
         for n in sorted(
@@ -298,8 +457,49 @@ class _Extractor(ast.NodeVisitor):
                     if at is not None:
                         cur = at.setdefault(t.attr, [])
                         cur.extend(r for r in refs if r not in cur)
+            if value is None:
+                continue
+            # v3: width provenance, str consts, jit aliases, metric defs
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    tag = _width_tag(value, canon, param_set, local_tags)
+                    local_tags[t.id] = tag
+                    if WIDTH_LOCAL_RE.search(t.id):
+                        width_locals.append(
+                            {"name": t.id, "line": n.lineno,
+                             "col": n.col_offset, "tag": tag}
+                        )
+                    s = _const_str(value, str_env)
+                    if s is not None:
+                        str_env[t.id] = s
+                    if _jit_ref(value):
+                        jit_aliases.add(t.id)
+                elif (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    self._maybe_metric_def(t.attr, value, str_env)
 
-        calls = self._collect_calls(own)
+        calls = self._collect_calls(own, canon, param_set, local_tags)
+        metric_uses = self._collect_metric_uses(own)
+        release_calls = self._collect_release_calls(node, own)
+        if "release" in node.name and any(
+            isinstance(n, ast.Assign)
+            and isinstance(n.value, ast.Constant)
+            and n.value.value is False
+            and any(
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                for t in n.targets
+            )
+            for n in own
+        ):
+            # a stage-release method: flips a self-owned ownership flag
+            # off — pool-ownership requires its call sites to be
+            # token-guarded (test + clear before the call)
+            self.release_defs.append(node.name)
         effects = direct_effects(own, self.ctx, cls=cls, globals_decl=globals_decl)
         self.functions.append(
             {
@@ -309,7 +509,13 @@ class _Extractor(ast.NodeVisitor):
                 "is_async": is_async,
                 "cls": cls,
                 "params": params,
+                "arg_names": arg_names,
+                "arg_defaults": arg_defaults,
                 "locals": local_types,
+                "jit_aliases": sorted(jit_aliases),
+                "width_locals": width_locals,
+                "metric_uses": metric_uses,
+                "release_calls": release_calls,
                 "calls": calls,
                 "effects": effects,
             }
@@ -332,18 +538,100 @@ class _Extractor(ast.NodeVisitor):
             for t in node.targets:
                 if isinstance(t, ast.Name) and refs:
                     self.module_vars[t.id] = refs
+            self._module_value(node.targets, node.value)
         self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if not self.scope and node.value is not None:
+            self._module_value([node.target], node.value)
+        self.generic_visit(node)
+
+    def _module_value(self, targets, value) -> None:
+        """Module-scope constants + jit-wrapper bindings (v3 raw
+        material): int/tuple-of-int consts (the bucket rung tables),
+        str consts (metric-name prefixes), and names assigned from
+        ``registry.jitted(...)`` — the dispatchable program wrappers
+        retrace-hazard tracks call sites of."""
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            return
+        ints: Optional[List[int]] = None
+        if isinstance(value, ast.Constant) and isinstance(value.value, int) \
+                and not isinstance(value.value, bool):
+            ints = [value.value]
+        elif isinstance(value, (ast.Tuple, ast.List)) and value.elts and all(
+            isinstance(e, ast.Constant)
+            and isinstance(e.value, int)
+            and not isinstance(e.value, bool)
+            for e in value.elts
+        ):
+            ints = [e.value for e in value.elts]
+        for name in names:
+            if ints is not None:
+                self.module_consts[name] = ints
+            s = _const_str(value, self.module_strs)
+            if s is not None:
+                self.module_strs[name] = s
+            if (
+                isinstance(value, ast.Call)
+                and (self.ctx.canon(dotted_name(value.func)) or "").rsplit(
+                    ".", 1
+                )[-1] == "jitted"
+            ):
+                self.jit_wrappers.append(name)
+            self._maybe_metric_def(name, value, self.module_strs)
+
+    def _maybe_metric_def(self, attr: str, value, str_env: Dict[str, str]) -> None:
+        """Record a prometheus Counter/Gauge/Histogram/Summary
+        construction assigned to ``attr`` (metric-label-drift raw
+        material: declared name + label set)."""
+        if not isinstance(value, ast.Call):
+            return
+        if self.ctx.canon(dotted_name(value.func)) not in _PROM_TYPES:
+            return
+        name = _const_str(value.args[0], str_env) if value.args else None
+        # labels: [] == registered label-free; None == a label argument
+        # EXISTS but is statically unresolvable (a variable) — the rule
+        # must skip label checks then, not treat the metric as unlabeled
+        labels: Optional[List[str]] = []
+        for kw in value.keywords:
+            if kw.arg in ("labelnames", "labels"):
+                labels = _label_list(kw.value)
+        if labels == [] and len(value.args) >= 3:
+            labels = _label_list(value.args[2])
+        self.metric_defs.append(
+            {
+                "attr": attr,
+                "name": name,
+                "labels": labels,  # None == statically unresolvable
+                "line": value.lineno,
+                "col": value.col_offset,
+            }
+        )
 
     # -- call collection ----------------------------------------------
 
-    def _collect_calls(self, own: Sequence[ast.AST]) -> List[dict]:
+    def _collect_calls(
+        self, own: Sequence[ast.AST], canon, param_set: Set[str],
+        local_tags: Dict[str, list],
+    ) -> List[dict]:
         out: List[dict] = []
         for node in own:
             if not isinstance(node, ast.Call):
                 continue
             target = dotted_name(node.func)
             if not target:
-                continue
+                # `asyncio.get_running_loop().run_in_executor(...)` — the
+                # receiver is a call, so no dotted name exists, but the
+                # dispatched callable (arg 1) must still reach
+                # pool-ownership; record the bare method as the target
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "run_in_executor"
+                ):
+                    target = "run_in_executor"
+                else:
+                    continue
             awaited = wrapped = False
             cur: ast.AST = node
             parent = getattr(cur, "_ll_parent", None)
@@ -361,6 +649,18 @@ class _Extractor(ast.NodeVisitor):
             discarded = isinstance(
                 getattr(node, "_ll_parent", None), ast.Expr
             )
+            args: List[dict] = []
+            for a in node.args:
+                if isinstance(a, ast.Starred):
+                    args.append({"tag": ["star"]})
+                else:
+                    args.append(_arg_record(a, canon, param_set, local_tags))
+            kwargs: Dict[str, dict] = {}
+            for kw in node.keywords:
+                if kw.arg is not None:  # **expansions contribute nothing
+                    kwargs[kw.arg] = _arg_record(
+                        kw.value, canon, param_set, local_tags
+                    )
             out.append(
                 {
                     "target": target,
@@ -369,6 +669,130 @@ class _Extractor(ast.NodeVisitor):
                     "awaited": awaited,
                     "wrapped": wrapped,
                     "discarded": discarded,
+                    "in_loop": enclosing_loop(node) is not None,
+                    "args": args,
+                    "kwargs": kwargs,
+                }
+            )
+        return out
+
+    def _collect_metric_uses(self, own: Sequence[ast.AST]) -> List[dict]:
+        """Sites that touch a metric object: ``<chain>.labels(...)`` and
+        bare ``<chain>.inc/dec/observe/set(...)`` where the receiver is
+        an attribute chain.  The join key is the receiver's final
+        attribute name; metric-label-drift matches it against every
+        registered metric slot."""
+        out: List[dict] = []
+        for node in own:
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            op = node.func.attr
+            recv = node.func.value
+            if op == "labels":
+                if isinstance(recv, ast.Attribute):
+                    attr = recv.attr
+                elif isinstance(recv, ast.Name):
+                    attr = recv.id
+                else:
+                    continue
+                out.append(
+                    {
+                        "attr": attr,
+                        "op": "labels",
+                        "line": node.lineno,
+                        "col": node.col_offset,
+                        "kwnames": sorted(
+                            kw.arg for kw in node.keywords if kw.arg
+                        ),
+                        "nargs": len(node.args),
+                    }
+                )
+            elif op in _METRIC_OPS and isinstance(
+                recv, (ast.Attribute, ast.Name)
+            ):
+                # Name receivers included: a module-level metric used
+                # bare (JOBS.inc()) drifts exactly like self.m.jobs.inc().
+                # The receiver chain rides along so the rule can require
+                # a metric-ish receiver for `.set()` — a verb shared with
+                # Event/Future-likes, where an attr-name collision with a
+                # labeled gauge must not manufacture a finding.
+                out.append(
+                    {
+                        "attr": recv.attr
+                        if isinstance(recv, ast.Attribute)
+                        else recv.id,
+                        "chain": dotted_name(recv) or "",
+                        "op": op,
+                        "line": node.lineno,
+                        "col": node.col_offset,
+                        "kwnames": [],
+                        "nargs": len(node.args),
+                    }
+                )
+        return out
+
+    def _collect_release_calls(self, func, own: Sequence[ast.AST]) -> List[dict]:
+        """Call sites of release-ish methods with their token-guard shape:
+        is the call inside an ``if <token>:`` whose body clears the token
+        (assigns False to an expression the test reads) BEFORE the call,
+        and does any ``await`` sit inside that guarded section?"""
+        out: List[dict] = []
+        for node in own:
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and "release" in node.func.attr
+            ):
+                continue
+            pos = (node.lineno, node.col_offset)
+            # EVERY enclosing `if` body up to the function is a guard
+            # candidate: a correct test-and-clear may wrap the release
+            # in a further nested condition
+            guards: List[ast.If] = []
+            cur = node
+            while True:
+                parent = getattr(cur, "_ll_parent", None)
+                if parent is None or parent is func:
+                    break
+                if (
+                    isinstance(parent, ast.If)
+                    and getattr(cur, "_ll_field", "") == "body"
+                ):
+                    guards.append(parent)
+                cur = parent
+            cleared = False
+            await_line: Optional[int] = None
+            for guard in guards:
+                test_src = unparse(guard.test)
+                test_nodes = set(map(id, ast.walk(guard.test)))
+                for n in ast.walk(guard):
+                    if id(n) in test_nodes:
+                        continue
+                    npos = (getattr(n, "lineno", 0), getattr(n, "col_offset", 0))
+                    if (
+                        isinstance(n, ast.Assign)
+                        and isinstance(n.value, ast.Constant)
+                        and n.value.value is False
+                        and npos < pos
+                        and any(
+                            unparse(t) and unparse(t) in test_src
+                            for t in n.targets
+                        )
+                    ):
+                        cleared = True
+                    if isinstance(n, ast.Await) and npos < pos:
+                        await_line = n.lineno
+                if cleared:
+                    break
+            out.append(
+                {
+                    "method": node.func.attr,
+                    "recv": unparse(node.func.value)[:60],
+                    "line": node.lineno,
+                    "col": node.col_offset,
+                    "guarded": bool(guards),
+                    "cleared": cleared,
+                    "await_line": await_line,
                 }
             )
         return out
@@ -392,6 +816,10 @@ def extract_summary(
         "imports": ex.imports,
         "classes": ex.classes,
         "module_vars": ex.module_vars,
+        "module_consts": ex.module_consts,
+        "jit_wrappers": ex.jit_wrappers,
+        "metric_defs": ex.metric_defs,
+        "release_defs": sorted(set(ex.release_defs)),
         "functions": ex.functions,
         "suppress_lines": {str(k): sorted(v) for k, v in per_line.items()},
         "suppress_file": sorted(per_file),
@@ -581,8 +1009,11 @@ class Project:
 
     def _resolve_name(self, s: dict, fs: dict, name: str) -> List[str]:
         module = s["module"]
-        # lexical scope chain: f.g.name for each ancestor scope of qname
-        scope_parts = fs["qname"].split(".")[:-1]
+        # lexical scope chain: f.g.name for each ancestor scope of qname,
+        # INCLUDING the function's own scope — its nested defs are
+        # visible as bare names inside it (run_in_executor(None, nested)
+        # must resolve for pool-ownership to judge the callable)
+        scope_parts = fs["qname"].split(".")
         for i in range(len(scope_parts), -1, -1):
             cand = ".".join(scope_parts[:i] + [name])
             fq = f"{module}:{cand}"

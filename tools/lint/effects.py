@@ -62,7 +62,11 @@ BLOCKING_CALLS = {
 
 _LOCK_CTORS = {"threading.Lock", "threading.RLock"}
 
-PROPAGATED = ("blocks", "host-sync")
+# ``mutates-unlocked`` (a self.*/global write with NO threading lock
+# held) closes transitively like blocks/host-sync: pool-ownership uses
+# it to prove an executor-dispatched callable reaches cross-thread
+# mutation of loop-owned state.
+PROPAGATED = ("blocks", "host-sync", "mutates-unlocked")
 
 
 def import_aliases(tree: ast.Module) -> Dict[str, str]:
@@ -180,6 +184,24 @@ def direct_effects(
             return True
         return _is_device_producer(node, ctx.device_aliases)
 
+    def under_thread_lock(node: ast.AST) -> bool:
+        # does a `with <threading lock>:` enclose the write, inside this
+        # function?  (an asyncio lock does NOT protect cross-thread use)
+        cur = node
+        while True:
+            parent = getattr(cur, "_ll_parent", None)
+            if parent is None or isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return False
+            if isinstance(parent, ast.With):
+                if any(
+                    ctx.is_thread_lock(item.context_expr, cls)
+                    for item in parent.items
+                ):
+                    return True
+            cur = parent
+
     for node in own:
         if isinstance(node, ast.Await):
             add("awaits", node, "await")
@@ -202,8 +224,18 @@ def direct_effects(
                     and t.value.id == "self"
                 ):
                     add("mutates-shared", node, f"writes self.{t.attr}")
+                    if not under_thread_lock(node):
+                        add(
+                            "mutates-unlocked", node,
+                            f"writes self.{t.attr} with no threading lock held",
+                        )
                 elif isinstance(t, ast.Name) and t.id in globals_decl:
                     add("mutates-shared", node, f"writes global {t.id}")
+                    if not under_thread_lock(node):
+                        add(
+                            "mutates-unlocked", node,
+                            f"writes global {t.id} with no threading lock held",
+                        )
         elif isinstance(node, ast.Call):
             dn = ctx.canon(dotted_name(node.func))
             if dn in BLOCKING_CALLS:
@@ -324,7 +356,10 @@ def root_site(project, fq: str, eff: str) -> Optional[Tuple[str, int]]:
 # ---------------------------------------------------------------------------
 
 _CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".cache.json")
-_CACHE_VERSION = 1
+# v2: summaries grew the v3 whole-program raw material (call arg
+# provenance, width locals, metric defs/uses, release guards); a v1
+# cache must not feed the new rules empty fields
+_CACHE_VERSION = 2
 
 
 def _lint_stamp() -> str:

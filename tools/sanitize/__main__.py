@@ -1,0 +1,53 @@
+"""CLI: ``python -m tools.sanitize [--check]`` — build csrc/*.c under
+ASAN+UBSAN and replay the differential vectors.  Exit 0 clean / 1
+findings / 0 with a visible notice when no sanitizer-capable compiler
+exists.  Tier-1 runs the same gate via tests/test_lodelint.py.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools import sanitize
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.sanitize",
+        description=(
+            "native ASAN/UBSAN differential gate for "
+            "lodestar_tpu/native/csrc (see docs/NATIVE.md)"
+        ),
+    )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="explicit gate mode (the default invocation is identical; "
+        "the flag exists for CI readability)",
+    )
+    ap.add_argument(
+        "--cc",
+        default=None,
+        help="compiler to use (default: probe $LODESTAR_TPU_SAN_CC, "
+        "clang, gcc, cc for sanitizer support)",
+    )
+    ap.add_argument(
+        "--fresh",
+        action="store_true",
+        help="rebuild even when sources and flags are unchanged",
+    )
+    args = ap.parse_args(argv)
+    if args.cc is not None and not sanitize._probe(
+        args.cc, sanitize.BUILD_DIR
+    ):
+        print(
+            f"sanitize: --cc {args.cc} cannot build+run sanitized "
+            "binaries here",
+            file=sys.stderr,
+        )
+        return 2
+    return sanitize.run_gate(cc=args.cc, fresh=args.fresh)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

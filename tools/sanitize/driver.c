@@ -1,0 +1,188 @@
+/* Sanitizer replay driver for the native C hot loops (csrc/*.c).
+ *
+ * Built by `python -m tools.sanitize` together with the production
+ * translation units under -fsanitize=address,undefined
+ * -fno-sanitize-recover, then fed a vector file the Python side
+ * generates from the same oracles the differential tests pin
+ * (tests/test_native_h2c.py / hashlib / the production .so):
+ *
+ *   h2c     <msg_hex> <dst_hex> <expected_192B_hex>
+ *   h2c_err <msg_hex> <dst_hex>            # must return rc != 0
+ *   sha256  <msg_hex> <digest_hex>
+ *   pairs   <in_hex(n*64B)> <out_hex(n*32B)>
+ *   layer   <nodes_hex> <zero_32B_hex> <out_hex>
+ *   snappy  <msg_hex>                      # compress->uncompress == input
+ *   xxh64   <msg_hex> <seed_dec> <expected_u64_hex>
+ *   crc32c  <msg_hex> <expected_u32_hex>
+ *
+ * "-" denotes an empty byte string.  Every input is copied into an
+ * exactly-sized heap buffer so ASAN red-zones sit directly past the
+ * last byte — an off-by-one read in the C under test aborts the run.
+ * Exit status: 0 all vectors replayed clean, 1 any mismatch (a
+ * sanitizer failure aborts with its own report before we get here).
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern void ls_sha256(const uint8_t *data, size_t len, uint8_t out[32]);
+extern void ls_hash_pairs(const uint8_t *in, uint8_t *out, size_t n);
+extern void ls_hash_layer(const uint8_t *in, size_t n, const uint8_t zero[32],
+                          uint8_t *out);
+extern uint64_t ls_xxh64(const uint8_t *p, size_t len, uint64_t seed);
+extern uint32_t ls_crc32c(const uint8_t *p, size_t len);
+extern size_t ls_snappy_max_compressed(size_t n);
+extern long ls_snappy_compress(const uint8_t *in, size_t n, uint8_t *out);
+extern long ls_snappy_uncompressed_length(const uint8_t *in, size_t n);
+extern long ls_snappy_uncompress(const uint8_t *in, size_t n, uint8_t *out,
+                                 size_t out_cap);
+extern void ls_h2c_warmup(void);
+extern int ls_hash_to_g2(const uint8_t *msg, size_t msg_len, const uint8_t *dst,
+                         size_t dst_len, uint8_t out[192]);
+
+static int hexval(int c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+/* "-" or hex -> exactly-sized heap buffer (never NULL; len may be 0) */
+static uint8_t *unhex(const char *s, size_t *len_out) {
+  if (strcmp(s, "-") == 0) {
+    *len_out = 0;
+    return (uint8_t *)malloc(1);
+  }
+  size_t n = strlen(s);
+  if (n % 2) return NULL;
+  uint8_t *buf = (uint8_t *)malloc(n / 2 ? n / 2 : 1);
+  if (!buf) return NULL;
+  for (size_t i = 0; i < n / 2; i++) {
+    int hi = hexval(s[2 * i]), lo = hexval(s[2 * i + 1]);
+    if (hi < 0 || lo < 0) {
+      free(buf);
+      return NULL;
+    }
+    buf[i] = (uint8_t)((hi << 4) | lo);
+  }
+  *len_out = n / 2;
+  return buf;
+}
+
+static int failures = 0;
+
+static void fail(int lineno, const char *op, const char *why) {
+  fprintf(stderr, "sanitize-driver: vector line %d (%s): %s\n", lineno, op,
+          why);
+  failures++;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <vector-file>\n", argv[0]);
+    return 2;
+  }
+  FILE *f = fopen(argv[1], "r");
+  if (!f) {
+    fprintf(stderr, "sanitize-driver: cannot open %s\n", argv[1]);
+    return 2;
+  }
+  ls_h2c_warmup();
+  char op[16], a[8192], b[8192], c[8192];
+  int lineno = 0, replayed = 0;
+  char line[24600];
+  while (fgets(line, sizeof line, f)) {
+    lineno++;
+    if (line[0] == '#' || line[0] == '\n') continue;
+    a[0] = b[0] = c[0] = 0;
+    int n = sscanf(line, "%15s %8191s %8191s %8191s", op, a, b, c);
+    if (n < 2) {
+      fail(lineno, "parse", "unparseable vector line");
+      continue;
+    }
+    size_t alen = 0, blen = 0, clen = 0;
+    uint8_t *ab = unhex(a, &alen);
+    uint8_t *bb = n >= 3 ? unhex(b, &blen) : NULL;
+    uint8_t *cb = n >= 4 ? unhex(c, &clen) : NULL;
+    if (!ab || (n >= 3 && !bb && strcmp(op, "xxh64") != 0) ||
+        (n >= 4 && !cb)) {
+      fail(lineno, op, "bad hex field");
+      goto next;
+    }
+    if (strcmp(op, "h2c") == 0) {
+      uint8_t out[192];
+      int rc = ls_hash_to_g2(ab, alen, bb, blen, out);
+      if (rc != 0)
+        fail(lineno, op, "ls_hash_to_g2 returned nonzero");
+      else if (clen != 192 || memcmp(out, cb, 192) != 0)
+        fail(lineno, op, "affine point differs from the oracle");
+    } else if (strcmp(op, "h2c_err") == 0) {
+      uint8_t out[192];
+      if (ls_hash_to_g2(ab, alen, bb, blen, out) == 0)
+        fail(lineno, op, "oversized input unexpectedly accepted");
+    } else if (strcmp(op, "sha256") == 0) {
+      uint8_t out[32];
+      ls_sha256(ab, alen, out);
+      if (blen != 32 || memcmp(out, bb, 32) != 0)
+        fail(lineno, op, "digest differs from hashlib");
+    } else if (strcmp(op, "pairs") == 0) {
+      size_t pairs = alen / 64;
+      uint8_t *out = (uint8_t *)malloc(pairs * 32 ? pairs * 32 : 1);
+      ls_hash_pairs(ab, out, pairs);
+      if (blen != pairs * 32 || memcmp(out, bb, blen) != 0)
+        fail(lineno, op, "merkle parents differ from hashlib");
+      free(out);
+    } else if (strcmp(op, "layer") == 0) {
+      size_t nodes = alen / 32, parents = (nodes + 1) / 2;
+      uint8_t *out = (uint8_t *)malloc(parents * 32 ? parents * 32 : 1);
+      ls_hash_layer(ab, nodes, bb, out);
+      if (clen != parents * 32 || memcmp(out, cb, clen) != 0)
+        fail(lineno, op, "merkle layer differs from hashlib");
+      free(out);
+    } else if (strcmp(op, "snappy") == 0) {
+      size_t cap = ls_snappy_max_compressed(alen);
+      uint8_t *comp = (uint8_t *)malloc(cap ? cap : 1);
+      long clen2 = ls_snappy_compress(ab, alen, comp);
+      if (clen2 < 0) {
+        fail(lineno, op, "compression failed");
+      } else {
+        long ulen = ls_snappy_uncompressed_length(comp, (size_t)clen2);
+        if (ulen != (long)alen) {
+          fail(lineno, op, "uncompressed_length != input length");
+        } else {
+          uint8_t *back = (uint8_t *)malloc(alen ? alen : 1);
+          long got = ls_snappy_uncompress(comp, (size_t)clen2, back, alen);
+          if (got != (long)alen || memcmp(back, ab, alen) != 0)
+            fail(lineno, op, "roundtrip differs from input");
+          free(back);
+        }
+      }
+      free(comp);
+    } else if (strcmp(op, "xxh64") == 0) {
+      uint64_t seed = strtoull(b, NULL, 10);
+      uint64_t want = strtoull(c, NULL, 16);
+      if (ls_xxh64(ab, alen, seed) != want)
+        fail(lineno, op, "hash differs from the production library");
+    } else if (strcmp(op, "crc32c") == 0) {
+      uint32_t want = (uint32_t)strtoul(b, NULL, 16);
+      if (ls_crc32c(ab, alen) != want)
+        fail(lineno, op, "checksum differs from the production library");
+    } else {
+      fail(lineno, op, "unknown vector op");
+    }
+    replayed++;
+  next:
+    free(ab);
+    free(bb);
+    free(cb);
+  }
+  fclose(f);
+  if (replayed == 0) {
+    fprintf(stderr, "sanitize-driver: empty vector file\n");
+    return 2;
+  }
+  printf("sanitize-driver: %d vector(s) replayed, %d failure(s)\n", replayed,
+         failures);
+  return failures ? 1 : 0;
+}
