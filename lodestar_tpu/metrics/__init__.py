@@ -119,6 +119,47 @@ class LodestarMetrics:
             "Attestations buffered for aggregation/packing",
             registry=registry,
         )
+        # network fault domain (ISSUE 15; panels in
+        # dashboards/lodestar_tpu_gossip.json +
+        # lodestar_tpu_range_sync.json, pinned both directions by
+        # tests/test_dashboards.py)
+        self.reqresp_requests_total = Counter(
+            f"{ns}_reqresp_requests_total",
+            "Client-side reqresp requests sent, by method",
+            ["method"],
+            registry=registry,
+        )
+        self.reqresp_request_timeouts_total = Counter(
+            f"{ns}_reqresp_request_timeouts_total",
+            "Client-side reqresp requests that hit the timeout, by method",
+            ["method"],
+            registry=registry,
+        )
+        self.reqresp_request_retries_total = Counter(
+            f"{ns}_reqresp_request_retries_total",
+            "Requests re-sent to ANOTHER peer after a failure/timeout "
+            "(request_any's bounded cross-peer retry), by method",
+            ["method"],
+            registry=registry,
+        )
+        self.reqresp_rate_limited_total = Counter(
+            f"{ns}_reqresp_rate_limited_total",
+            "Server-side requests shed by the GCRA rate limiter, by method",
+            ["method"],
+            registry=registry,
+        )
+        self.peer_score = Histogram(
+            f"{ns}_peer_score",
+            "Connected peers' rpc scores, observed each network heartbeat",
+            buckets=(-100, -50, -20, -10, -5, -1, 0, 1, 5, 10),
+            registry=registry,
+        )
+        self.gossip_mesh_peers = Gauge(
+            f"{ns}_gossip_mesh_peers",
+            "Gossip mesh degree per topic (mesh transports only)",
+            ["topic"],
+            registry=registry,
+        )
         # range sync (sync/range metrics role: batches by terminal status,
         # usable peers, current chain target)
         self.sync_batches_total = Counter(

@@ -1,4 +1,11 @@
 from .transport import Endpoint, InProcessHub  # noqa: F401
+from .fabric import MeshFabric  # noqa: F401
+from .loopback import LoopbackNet  # noqa: F401
 from .network import Network  # noqa: F401
 from .gossip import Eth2Gossip, GossipType  # noqa: F401
-from .peers import PeerAction, PeerManager, PeerRpcScoreStore  # noqa: F401
+from .peers import (  # noqa: F401
+    PeerAction,
+    PeerBannedError,
+    PeerManager,
+    PeerRpcScoreStore,
+)

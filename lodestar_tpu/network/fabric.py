@@ -1,0 +1,484 @@
+"""MeshFabric: the pluggable transport seam (ROADMAP 6's refactor
+unlock, ISSUE 15).
+
+One gossipsub-v1.1-shaped router — degree-limited per-topic meshes,
+GRAFT/PRUNE heartbeat, IHAVE/IWANT recovery, multiplexed reqresp — that
+runs over ANY link layer.  Three bindings share this class:
+
+* ``loopback.LoopbackNet``  — in-process shared-memory links (the swarm
+  harness fabric, ``testing/swarm.py``);
+* ``wire.WireTransport``    — OS sockets + noise AEAD sessions (the
+  production TCP stack);
+* fault-wrapped variants of either, via the ``net.transport.*``
+  checkpoints below — no wrapper class needed, the seams are in the
+  shared code path.
+
+The Link contract (duck-typed; see ``loopback.LoopbackLink`` and
+``wire._Conn``):
+
+* ``peer_id``                — remote peer id (stable per connection)
+* ``async send(plain)``      — deliver one plaintext frame to the peer;
+  raising ``ConnectionError``/``OSError`` means the link is dead
+* ``close()``                — release resources; idempotent
+* ``closed``                 — bool
+
+The fabric owns per-link protocol state (``link.topics``,
+``link.pending_reqs``) which it initializes in ``add_link``; the link
+layer calls ``await fabric.on_frame(link, plain)`` per received frame
+and ``fabric.drop_link(link)`` when the link dies.
+
+Wire format of a plaintext frame (encryption, if any, is the link
+layer's business):
+
+    plain   := 1B type || body
+    REQ     := 8B req id || 2B proto len || proto || data
+    RESP_OK / RESP_ERR := 8B req id || data / utf8 error
+    GOSSIP  := 2B topic len || topic || raw message
+    SUB/UNSUB/GRAFT/PRUNE := 2B topic len || topic
+    IHAVE   := 2B topic len || topic || N * 20B message ids
+    IWANT   := 2B topic len || topic || N * 20B message ids
+
+Deterministic fault checkpoints (docs/FAULTS.md): every outbound frame
+passes ``net.transport.write`` and every inbound frame
+``net.transport.read`` with ``src``/``dst``/``ftype`` context — a
+``faults.Drop`` (or any ``FaultError``) discards the frame, a
+``faults.Delay`` stalls it; scoping the plan with ``match=`` scripts
+partitions and slow links per peer pair without touching healthy
+traffic.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Awaitable, Dict, List, Optional, Set, Tuple
+
+from .gossip import compute_message_id
+from .transport import GossipHandler, RequestHandler
+from lodestar_tpu.testing import faults
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("fabric")
+
+# frame types
+_REQ = 0x01
+_RESP_OK = 0x02
+_RESP_ERR = 0x03
+_GOSSIP = 0x10
+_SUB = 0x15
+_UNSUB = 0x16
+_GRAFT = 0x11
+_PRUNE = 0x12
+_IHAVE = 0x13
+_IWANT = 0x14
+
+# gossipsub-shaped mesh degrees (gossipsub v1.1 defaults)
+MESH_D = 6
+MESH_D_LOW = 4
+MESH_D_HIGH = 10
+IHAVE_PEERS = 3
+HEARTBEAT_S = 1.0
+REQUEST_TIMEOUT_S = 10.0
+
+_MSG_ID_LEN = 20
+
+
+def _with_topic(topic: str, rest: bytes = b"") -> bytes:
+    tb = topic.encode()
+    return len(tb).to_bytes(2, "big") + tb + rest
+
+
+def _read_topic(body: bytes) -> Tuple[str, bytes]:
+    n = int.from_bytes(body[:2], "big")
+    return body[2 : 2 + n].decode(), body[2 + n :]
+
+
+@dataclass
+class _TopicState:
+    handler: GossipHandler
+    mesh: Set[str] = field(default_factory=set)
+
+
+class MeshFabric:
+    """Endpoint-compatible gossip mesh + reqresp mux over pluggable links.
+
+    Implements the surface consumed by ReqRespNode / Eth2Gossip /
+    Network (handle / request / subscribe / unsubscribe / publish /
+    deliver / close) plus the link-layer callbacks (add_link / on_frame
+    / drop_link) and mesh maintenance (heartbeat).
+    """
+
+    def __init__(self, peer_id: str, request_timeout: float = REQUEST_TIMEOUT_S):
+        self.peer_id = peer_id
+        self.request_timeout = request_timeout
+        self.conns: Dict[str, object] = {}  # peer_id -> Link
+        self.request_handlers: Dict[str, RequestHandler] = {}
+        self._topics: Dict[str, _TopicState] = {}
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._req_counter = 0
+        self._tasks: Set[asyncio.Task] = set()
+        self._hb_task: Optional[asyncio.Task] = None
+        # recent message cache for IWANT serving + IHAVE digests
+        self._mcache: "OrderedDict[bytes, Tuple[str, bytes]]" = OrderedDict()
+        self._mcache_max = 512
+        self._seen: "OrderedDict[bytes, None]" = OrderedDict()
+        self._seen_max = 1 << 15
+        self.frames_dropped = 0  # write-side frames lost to injected faults
+
+    # -- link lifecycle ------------------------------------------------
+
+    async def add_link(self, link) -> str:
+        """Register a live link and announce our subscriptions on it.
+        A reconnect supersedes (and closes) the previous link."""
+        link.topics = getattr(link, "topics", set())
+        link.pending_reqs = getattr(link, "pending_reqs", set())
+        old = self.conns.get(link.peer_id)
+        self.conns[link.peer_id] = link
+        if old is not None:
+            # registered FIRST so drop_link sees the replacement and
+            # leaves mesh membership alone, but still fails the old
+            # link's in-flight requests immediately (binding-uniform —
+            # the TCP recv loop used to provide this as a side effect)
+            self.drop_link(old)
+        for topic in self._topics:
+            await self._send_frame(link, bytes([_SUB]) + _with_topic(topic))
+        return link.peer_id
+
+    def drop_link(self, link) -> None:
+        if self.conns.get(link.peer_id) is link:
+            # only the ACTIVE link's death evicts peer state — a link
+            # superseded by a reconnect must not wipe the (still valid)
+            # mesh membership of its replacement
+            del self.conns[link.peer_id]
+            for st in self._topics.values():
+                st.mesh.discard(link.peer_id)
+        # fail this link's in-flight requests now instead of letting
+        # callers wait out the request timeout
+        for rid in list(getattr(link, "pending_reqs", ())):
+            fut = self._pending.get(rid)
+            if fut is not None and not fut.done():
+                fut.set_exception(ConnectionError("peer disconnected"))
+        if getattr(link, "pending_reqs", None):
+            link.pending_reqs.clear()
+        link.close()
+
+    def disconnect_peer(self, peer_id: str) -> None:
+        """Sever the live link to a peer (ban enforcement: score
+        bookkeeping alone leaves the connection — and its mesh slots —
+        alive)."""
+        link = self.conns.get(peer_id)
+        if link is not None:
+            self.drop_link(link)
+
+    def start_heartbeat(self) -> None:
+        if self._hb_task is None:
+            self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+
+    def close(self) -> None:
+        if self._hb_task:
+            self._hb_task.cancel()
+            self._hb_task = None
+        for link in list(self.conns.values()):
+            link.close()
+        self.conns.clear()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("transport closed"))
+        self._pending.clear()
+        for t in self._tasks:
+            t.cancel()
+
+    # -- frame send path (the net.transport.write seam) ----------------
+
+    async def _send_frame(self, link, plain: bytes) -> None:
+        """One outbound frame through the write checkpoint.  An injected
+        Delay stalls just this frame; Drop (or any FaultError) discards
+        it — the deterministic model of a lossy link.  Real link errors
+        drop the link itself."""
+        try:
+            faults.fire(
+                "net.transport.write",
+                src=self.peer_id,
+                dst=link.peer_id,
+                ftype=plain[0],
+            )
+        except faults.Delay as d:
+            await asyncio.sleep(d.seconds)
+        except faults.FaultError:
+            self.frames_dropped += 1
+            return
+        try:
+            await link.send(plain)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            _log.debug(
+                f"send to {link.peer_id} failed: {type(e).__name__}: {e}"
+            )
+            self.drop_link(link)
+
+    def _bg_send(self, link, plain: bytes) -> None:
+        self._bg(self._send_frame(link, plain))
+
+    # -- reqresp (Endpoint surface) ------------------------------------
+
+    def handle(self, protocol_id: str, handler: RequestHandler) -> None:
+        self.request_handlers[protocol_id] = handler
+
+    async def request(self, to_peer: str, protocol_id: str, data: bytes) -> bytes:
+        link = self.conns.get(to_peer)
+        if link is None:
+            raise ConnectionError(f"not connected to {to_peer}")
+        self._req_counter += 1
+        req_id = self._req_counter
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        pb = protocol_id.encode()
+        link.pending_reqs.add(req_id)
+        try:
+            await self._send_frame(
+                link,
+                bytes([_REQ])
+                + req_id.to_bytes(8, "big")
+                + len(pb).to_bytes(2, "big")
+                + pb
+                + data,
+            )
+            return await asyncio.wait_for(fut, self.request_timeout)
+        finally:
+            link.pending_reqs.discard(req_id)
+            self._pending.pop(req_id, None)
+
+    # -- gossip (Endpoint surface) -------------------------------------
+
+    def subscribe(self, topic: str, handler: GossipHandler) -> None:
+        self._topics[topic] = _TopicState(handler=handler)
+        self._broadcast_control(_SUB, topic)
+
+    def unsubscribe(self, topic: str) -> None:
+        if topic in self._topics:
+            del self._topics[topic]
+            self._broadcast_control(_UNSUB, topic)
+
+    def _broadcast_control(self, ftype: int, topic: str) -> None:
+        for link in list(self.conns.values()):
+            self._bg_send(link, bytes([ftype]) + _with_topic(topic))
+
+    async def publish(self, topic: str, message: bytes) -> int:
+        """Send to mesh peers (or all subscribed peers while the mesh is
+        still forming); returns receiver count."""
+        msg_id = compute_message_id(topic, message)
+        self._remember(topic, msg_id, message)
+        targets = self._forward_targets(topic, exclude=None)
+        frame = bytes([_GOSSIP]) + _with_topic(topic, message)
+        for pid in targets:
+            link = self.conns.get(pid)
+            if link:
+                self._bg_send(link, frame)
+        return len(targets)
+
+    def deliver(self, from_peer: str, topic: str, message: bytes) -> None:
+        st = self._topics.get(topic)
+        if st is None:
+            return
+        self._bg(st.handler(from_peer, topic, message))
+
+    def mesh_sizes(self) -> Dict[str, int]:
+        """Per-topic mesh degree (observability: the swarm-visible
+        mesh-size gauge reads this)."""
+        return {topic: len(st.mesh) for topic, st in self._topics.items()}
+
+    # -- internals -----------------------------------------------------
+
+    def _bg(self, coro: Awaitable) -> None:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    def _remember(self, topic: str, msg_id: bytes, message: bytes) -> None:
+        self._seen[msg_id] = None
+        while len(self._seen) > self._seen_max:
+            self._seen.popitem(last=False)
+        self._mcache[msg_id] = (topic, message)
+        while len(self._mcache) > self._mcache_max:
+            self._mcache.popitem(last=False)
+
+    def _forward_targets(self, topic: str, exclude: Optional[str]) -> List[str]:
+        st = self._topics.get(topic)
+        mesh = set(st.mesh) if st else set()
+        if not mesh:
+            mesh = {
+                p
+                for p, link in self.conns.items()
+                if topic in getattr(link, "topics", ())
+            }
+        mesh.discard(exclude)
+        return [p for p in mesh if p in self.conns]
+
+    async def on_frame(self, link, plain: bytes) -> None:
+        """Link-layer callback: one inbound plaintext frame, through the
+        net.transport.read checkpoint (Drop/FaultError = the frame was
+        lost in flight)."""
+        try:
+            faults.fire(
+                "net.transport.read",
+                src=link.peer_id,
+                dst=self.peer_id,
+                ftype=plain[0],
+            )
+        except faults.Delay as d:
+            await asyncio.sleep(d.seconds)
+        except faults.FaultError:
+            return
+        ftype, body = plain[0], plain[1:]
+        if ftype == _REQ:
+            req_id = int.from_bytes(body[:8], "big")
+            plen = int.from_bytes(body[8:10], "big")
+            proto = body[10 : 10 + plen].decode()
+            data = body[10 + plen :]
+            self._bg(self._serve_request(link, req_id, proto, data))
+        elif ftype in (_RESP_OK, _RESP_ERR):
+            req_id = int.from_bytes(body[:8], "big")
+            fut = self._pending.get(req_id)
+            if fut and not fut.done():
+                if ftype == _RESP_OK:
+                    fut.set_result(body[8:])
+                else:
+                    fut.set_exception(
+                        ConnectionError(body[8:].decode(errors="replace"))
+                    )
+        elif ftype == _GOSSIP:
+            topic, message = _read_topic(body)
+            msg_id = compute_message_id(topic, message)
+            if msg_id in self._seen:
+                return
+            self._remember(topic, msg_id, message)
+            self.deliver(link.peer_id, topic, message)
+            # forward within the mesh (multi-hop propagation)
+            frame = bytes([_GOSSIP]) + _with_topic(topic, message)
+            for pid in self._forward_targets(topic, exclude=link.peer_id):
+                c = self.conns.get(pid)
+                if c:
+                    self._bg_send(c, frame)
+        elif ftype == _SUB:
+            topic, _ = _read_topic(body)
+            link.topics.add(topic)
+        elif ftype == _UNSUB:
+            topic, _ = _read_topic(body)
+            link.topics.discard(topic)
+            st = self._topics.get(topic)
+            if st:
+                st.mesh.discard(link.peer_id)
+        elif ftype == _GRAFT:
+            topic, _ = _read_topic(body)
+            st = self._topics.get(topic)
+            if st is not None and len(st.mesh) < MESH_D_HIGH:
+                st.mesh.add(link.peer_id)
+            else:  # not subscribed or mesh full: refuse
+                self._bg_send(link, bytes([_PRUNE]) + _with_topic(topic))
+        elif ftype == _PRUNE:
+            topic, _ = _read_topic(body)
+            st = self._topics.get(topic)
+            if st:
+                st.mesh.discard(link.peer_id)
+        elif ftype == _IHAVE:
+            topic, rest = _read_topic(body)
+            if topic not in self._topics:
+                return
+            want = []
+            for i in range(0, len(rest), _MSG_ID_LEN):
+                mid = rest[i : i + _MSG_ID_LEN]
+                if len(mid) == _MSG_ID_LEN and mid not in self._seen:
+                    want.append(mid)
+            if want:
+                self._bg_send(
+                    link, bytes([_IWANT]) + _with_topic(topic, b"".join(want))
+                )
+        elif ftype == _IWANT:
+            topic, rest = _read_topic(body)
+            for i in range(0, len(rest), _MSG_ID_LEN):
+                mid = rest[i : i + _MSG_ID_LEN]
+                entry = self._mcache.get(mid)
+                if entry is not None:
+                    t, message = entry
+                    self._bg_send(
+                        link, bytes([_GOSSIP]) + _with_topic(t, message)
+                    )
+
+    async def _serve_request(
+        self, link, req_id: int, proto: str, data: bytes
+    ) -> None:
+        handler = self.request_handlers.get(proto)
+        rid = req_id.to_bytes(8, "big")
+        if handler is None:
+            await self._send_frame(
+                link, bytes([_RESP_ERR]) + rid + f"unsupported {proto}".encode()
+            )
+            return
+        try:
+            resp = await handler(link.peer_id, proto, data)
+            await self._send_frame(link, bytes([_RESP_OK]) + rid + resp)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if not link.closed:
+                await self._send_frame(
+                    link, bytes([_RESP_ERR]) + rid + str(e)[:256].encode()
+                )
+
+    # -- mesh maintenance ----------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(HEARTBEAT_S)
+                self._heartbeat_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                _log.warn(f"heartbeat failed: {type(e).__name__}: {e}")
+                continue
+
+    def _heartbeat_once(self) -> None:
+        for topic, st in self._topics.items():
+            st.mesh = {p for p in st.mesh if p in self.conns}
+            subscribers = [
+                p
+                for p, link in self.conns.items()
+                if topic in getattr(link, "topics", ())
+            ]
+            if len(st.mesh) < MESH_D_LOW:
+                candidates = [p for p in subscribers if p not in st.mesh]
+                random.shuffle(candidates)
+                for pid in candidates[: MESH_D - len(st.mesh)]:
+                    st.mesh.add(pid)
+                    link = self.conns.get(pid)
+                    if link:
+                        self._bg_send(link, bytes([_GRAFT]) + _with_topic(topic))
+            elif len(st.mesh) > MESH_D_HIGH:
+                excess = random.sample(
+                    sorted(st.mesh), len(st.mesh) - MESH_D
+                )
+                for pid in excess:
+                    st.mesh.discard(pid)
+                    link = self.conns.get(pid)
+                    if link:
+                        self._bg_send(link, bytes([_PRUNE]) + _with_topic(topic))
+            # IHAVE digests of the recent cache to a sample of
+            # subscribers.  Unlike canonical gossipsub this includes
+            # mesh members: a peer GRAFTed after a publish would
+            # otherwise never hear of it (mesh forwards only NEW
+            # messages), and the cost is one id list — IWANT only pulls
+            # unseen ids.
+            ids = [
+                mid for mid, (t, _) in self._mcache.items() if t == topic
+            ][-32:]
+            if ids:
+                sample = list(subscribers)
+                random.shuffle(sample)
+                payload = bytes([_IHAVE]) + _with_topic(topic, b"".join(ids))
+                for pid in sample[: IHAVE_PEERS + len(st.mesh)]:
+                    link = self.conns.get(pid)
+                    if link:
+                        self._bg_send(link, payload)

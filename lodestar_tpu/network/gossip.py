@@ -14,6 +14,7 @@ from dataclasses import dataclass
 from enum import Enum
 from typing import Awaitable, Callable, Dict, Optional
 
+from lodestar_tpu.testing import faults
 from lodestar_tpu.utils.queue import JobItemQueue, QueueType
 from lodestar_tpu.utils.snappy import compress as snappy_compress
 from lodestar_tpu.utils.snappy import decompress as snappy_decompress
@@ -143,6 +144,9 @@ class Eth2Gossip:
         self, gossip_type: GossipType, ssz_type, obj, subnet: Optional[int] = None
     ) -> int:
         topic = self._topic(gossip_type, subnet)
+        # chaos seam: a publish-side fault (armed per topic) surfaces to
+        # the caller — the node-local model of "could not publish"
+        faults.fire("net.gossip.publish", topic=topic)
         raw = snappy_compress(ssz_type.serialize(obj))
         self._seen_ids.add(compute_message_id(topic, raw))
         self._seen_fast_ids.add((topic, fast_message_id(raw)))
@@ -169,6 +173,17 @@ class Eth2Gossip:
         self._queues[topic] = queue
 
         async def on_message(from_peer: str, topic_: str, raw: bytes) -> None:
+            # chaos seam: Drop loses the delivery, Garble corrupts the
+            # payload in flight — the corrupted bytes then take the
+            # normal hostile-input path (deserialize failure → invalid
+            # count → peer scoring), which is exactly what the seam
+            # exists to prove
+            try:
+                faults.fire("net.gossip.deliver", peer=from_peer, topic=topic_)
+            except faults.Garble as g:
+                raw = g.mutate(raw)
+            except faults.FaultError:
+                return
             # cheap xxhash first-pass dedup (fastMsgIdFn role) before the
             # sha256 canonical id — most duplicates never get hashed fully
             fast_id = (topic_, fast_message_id(raw))

@@ -1,0 +1,136 @@
+"""Loopback link layer: shared-memory frame pipes between MeshFabric
+instances in one process (ISSUE 15 / ROADMAP 6).
+
+This is the swarm harness's fabric — the REAL gossip mesh, scoring,
+reqresp mux and rate limiter run unmodified (they live in MeshFabric and
+its consumers); only the bottom byte-moving layer is replaced with
+paired in-memory queues.  Unlike ``transport.InProcessHub`` (a one-hop
+policy double that broadcasts to every subscriber), a loopback swarm
+exercises multi-hop mesh propagation, GRAFT/PRUNE churn and IHAVE/IWANT
+recovery exactly as the TCP stack does.
+
+Per-direction delivery is FIFO: each link owns an unbounded deque
+drained by one pump task, so frame order on a link matches send order
+(the TCP guarantee) while cross-link interleaving is the event loop's —
+the same nondeterminism surface production has.  Fault scripting happens
+in MeshFabric's ``net.transport.read``/``write`` checkpoints (shared
+with the TCP binding); ``net.transport.connect`` fires here per
+``connect()`` so dial storms and unreachable-peer scripts work on the
+loopback too.
+"""
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Deque, Dict, Optional, Set, Tuple
+
+from .fabric import MeshFabric
+from lodestar_tpu.testing import faults
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("loopback")
+
+
+class LoopbackLink:
+    """One direction-agnostic attachment: ``send()`` enqueues toward the
+    remote fabric; a pump task dequeues and feeds the remote's
+    ``on_frame`` with the REMOTE side's link object (mirroring how each
+    end of a TCP connection owns its own _Conn)."""
+
+    def __init__(self, local: MeshFabric, remote: MeshFabric):
+        self.local = local
+        self.remote = remote
+        self.peer_id = remote.peer_id
+        self.topics: Set[str] = set()
+        self.pending_reqs: Set[int] = set()
+        self.closed = False
+        self.twin: Optional["LoopbackLink"] = None  # remote's link back to us
+        self._queue: Deque[bytes] = deque()
+        self._wakeup = asyncio.Event()
+        self._pump_task: Optional[asyncio.Task] = None
+
+    async def send(self, plain: bytes) -> None:
+        if self.closed:
+            raise ConnectionError(f"link to {self.peer_id} closed")
+        self._queue.append(plain)
+        self._wakeup.set()
+
+    def start(self) -> None:
+        if self._pump_task is None:
+            self._pump_task = asyncio.ensure_future(self._pump())
+
+    async def _pump(self) -> None:
+        try:
+            while True:
+                while not self._queue:
+                    self._wakeup.clear()
+                    await self._wakeup.wait()
+                plain = self._queue.popleft()
+                try:
+                    await self.remote.on_frame(self.twin, plain)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # a malformed frame breaks the connection, exactly
+                    # like the TCP recv loop's teardown
+                    _log.debug(
+                        f"loopback frame to {self.peer_id} failed: "
+                        f"{type(e).__name__}: {e}; dropping link"
+                    )
+                    self.remote.drop_link(self.twin)
+                    self.local.drop_link(self)
+                    return
+        except asyncio.CancelledError:
+            raise
+
+    def close(self) -> None:
+        self.closed = True
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            self._pump_task = None
+
+
+class LoopbackNet:
+    """Connection registry for a swarm of MeshFabrics in one process."""
+
+    def __init__(self):
+        self.fabrics: Dict[str, MeshFabric] = {}
+        self._links: Dict[Tuple[str, str], LoopbackLink] = {}
+
+    def register(self, fabric: MeshFabric) -> MeshFabric:
+        self.fabrics[fabric.peer_id] = fabric
+        return fabric
+
+    async def connect(self, a: MeshFabric, b: MeshFabric) -> None:
+        """Wire a<->b with a paired link per direction (idempotent:
+        reconnect supersedes, as a TCP redial would)."""
+        faults.fire("net.transport.connect", src=a.peer_id, dst=b.peer_id)
+        ab = LoopbackLink(a, b)
+        ba = LoopbackLink(b, a)
+        ab.twin, ba.twin = ba, ab
+        self._links[(a.peer_id, b.peer_id)] = ab
+        self._links[(b.peer_id, a.peer_id)] = ba
+        ab.start()
+        ba.start()
+        await a.add_link(ab)
+        await b.add_link(ba)
+
+    def disconnect(self, a_id: str, b_id: str) -> None:
+        """Hard-drop both directions (a crashed peer / RST, not a polite
+        goodbye): pending requests fail immediately on both ends."""
+        for src, dst in ((a_id, b_id), (b_id, a_id)):
+            link = self._links.pop((src, dst), None)
+            if link is not None:
+                fab = self.fabrics.get(src)
+                if fab is not None:
+                    fab.drop_link(link)
+                else:
+                    link.close()
+
+    def close(self) -> None:
+        for link in list(self._links.values()):
+            link.close()
+        self._links.clear()
+        for fab in list(self.fabrics.values()):
+            fab.close()
+        self.fabrics.clear()

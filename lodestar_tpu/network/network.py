@@ -44,22 +44,35 @@ class Network:
         db,
         peer_id: Optional[str] = None,
         endpoint=None,
+        rate_quota=None,  # None -> reqresp.DEFAULT_RATE_QUOTA
     ):
         """`endpoint` overrides the in-process hub attachment with any
         Endpoint-surface transport — production passes a
-        wire.WireTransport (TCP + noise + gossip mesh); tests pass the
+        wire.WireTransport (TCP + noise + gossip mesh), the swarm
+        harness a fabric.MeshFabric over loopback links; tests pass the
         hub double."""
         self.chain = chain
         self.db = db
         signed_block_wire_codec.configure(chain.cfg)
         self.endpoint = endpoint if endpoint is not None else Endpoint(hub, peer_id)
         self.peer_id = self.endpoint.peer_id
+        self.metrics = getattr(chain, "metrics", None)
         fork_digest = compute_fork_digest(
             chain.cfg.GENESIS_FORK_VERSION, chain.genesis_validators_root
         )
         self.gossip = Eth2Gossip(self.endpoint, fork_digest)
-        self.reqresp = ReqRespNode(self.endpoint)
+        self.reqresp = ReqRespNode(
+            self.endpoint,
+            rate_quota=rate_quota,
+            metrics=self.metrics,
+            on_rate_limited=self._on_rate_limited,
+        )
         self.peer_manager = PeerManager()
+        # a ban must sever the live transport link, not just the
+        # bookkeeping — otherwise the banned peer keeps its mesh slots
+        # and goes on exchanging frames until IT hangs up
+        self.peer_manager.on_ban = self._sever_peer_link
+        self._unknown_block_lock = asyncio.Lock()
         self.metadata = ssz.phase0.Metadata(seq_number=0, attnets=[False] * 64)
         # subnet services (network/subnets/ in the reference) are always
         # present; duty expiry + random-subnet rotation ride the chain
@@ -74,6 +87,18 @@ class Network:
 
         chain.clock.on_slot(_subnets_on_slot)
         self._register_reqresp_handlers()
+
+    def _sever_peer_link(self, peer_id: str) -> None:
+        disconnect = getattr(self.endpoint, "disconnect_peer", None)
+        if disconnect is not None:  # mesh transports; the hub double has
+            disconnect(peer_id)     # no persistent links to sever
+
+    def _on_rate_limited(self, peer: str, method: str) -> None:
+        """A shed reqresp flood is protocol misbehaviour: penalize the
+        flooder on both score registers so a sustained flood walks it
+        into disconnect/graylist (and eventually the ban lifecycle)."""
+        self.peer_manager.scores.apply_action(peer, PeerAction.HighToleranceError)
+        self.gossip.peer_score.on_behaviour_penalty(peer)
 
     # ------------------------------------------------------------------
     # reqresp server handlers (network/reqresp/handlers/)
@@ -154,6 +179,30 @@ class Network:
             BEACON_BLOCK_AND_BLOBS_SIDECAR_BY_ROOT, on_block_and_blobs_by_root
         )
 
+    async def _resolve_unknown_ancestry(self, from_peer: str, signed_block) -> None:
+        """Gossip block with an unknown parent: fetch the missing
+        ancestors by root and import the chain forward (unknownBlock.ts
+        role, now wired into the gossip pipeline).  Serialized — two
+        out-of-order blocks from one heal share one ancestor walk."""
+        from lodestar_tpu.sync.unknown_block import UnknownBlockSync
+
+        async with self._unknown_block_lock:
+            parent = "0x" + bytes(signed_block.message.parent_root).hex()
+            try:
+                if self.chain.fork_choice.has_block(parent):
+                    # an earlier walk already imported the ancestry
+                    await self.chain.process_block(signed_block)
+                else:
+                    await UnknownBlockSync(self, self.chain).resolve(signed_block)
+            except Exception as e:
+                _log.debug(
+                    f"unknown-ancestry resolve via {from_peer} failed: "
+                    f"{type(e).__name__}: {e}"
+                )
+                self.peer_manager.scores.apply_action(
+                    from_peer, PeerAction.HighToleranceError
+                )
+
     def _block_at_slot(self, slot: int):
         # canonical root via fork choice ancestors of head
         node = self.chain.fork_choice.proto_array.get_ancestor_at_or_before_slot(
@@ -207,6 +256,7 @@ class Network:
 
     def subscribe_core_topics(self) -> None:
         from lodestar_tpu.chain.validation import (
+            GossipErrorCode,
             GossipValidationError,
             validate_gossip_aggregate_and_proof,
             validate_gossip_attestation,
@@ -216,7 +266,13 @@ class Network:
         async def on_block(from_peer, signed_block):
             try:
                 await validate_gossip_block(self.chain, signed_block)
-            except GossipValidationError:
+            except GossipValidationError as e:
+                if e.code is GossipErrorCode.UNKNOWN_BEACON_BLOCK_ROOT:
+                    # unknown parent is a US problem (partition heal,
+                    # out-of-order delivery), not the forwarder's:
+                    # resolve the ancestry by root instead of punishing
+                    await self._resolve_unknown_ancestry(from_peer, signed_block)
+                    return
                 self.peer_manager.scores.apply_action(
                     from_peer, PeerAction.LowToleranceError
                 )
@@ -389,17 +445,29 @@ class Network:
 
     async def heartbeat(self, target_peers: int = 8) -> int:
         """One peer-maintenance round (peerManager.ts heartbeat):
-        disconnect bad-score peers, then top up from discovery.  Returns
-        the connected-peer count."""
+        quarantine/disconnect bad peers, prune unbounded per-peer state
+        (rate-limiter TATs, long-disconnected score entries), publish
+        peer observability, then top up from discovery.  Returns the
+        connected-peer count."""
         for pid in list(self.peer_manager.connected_peers()):
-            if self.peer_manager.scores.should_disconnect(
-                pid
-            ) or self.gossip.peer_score.should_graylist(pid):
+            if self.gossip.peer_score.should_graylist(pid):
+                # gossip-quarantined (e.g. served invalid blocks): this
+                # is ban-grade misbehaviour, not a soft disconnect — a
+                # reconnect before unban is refused outright
+                self.peer_manager.ban(pid)
+            elif self.peer_manager.scores.should_disconnect(pid):
                 self.peer_manager.on_disconnect(pid)
-                # scores are retained (not forgotten) so a graylisted
-                # peer that reconnects is still graylisted until its
-                # counters decay; decay() prunes zeroed entries
+                # rpc scores are retained so the peer is still suspect
+                # on reconnect until its score decays; maintain() prunes
+                # the entry once it has been disconnected long enough
+        # escalate score-banned peers, expire bans, prune stale entries
+        self.peer_manager.maintain()
+        # the GCRA limiter's per-(peer, method) TAT map grows with peer
+        # churn; prune entries whose window has long passed (a pruned
+        # key re-admits at full burst, which is the correct cold start)
+        self.reqresp.rate_limiter.prune()
         self.gossip.peer_score.decay()
+        self._publish_peer_metrics()
         discovery = getattr(self, "_discovery", None)
         if discovery is not None:
             connected = self.peer_manager.connected_peers()
@@ -427,6 +495,21 @@ class Network:
                         )
                         continue
         return len(self.peer_manager.connected_peers())
+
+    def _publish_peer_metrics(self) -> None:
+        """Heartbeat observability (ISSUE 15 / ROADMAP 8c): peer-score
+        distribution, per-topic mesh degree (mesh transports only), and
+        the ban counter."""
+        if self.metrics is None:
+            return
+        lm = self.metrics.lodestar
+        for pid in self.peer_manager.connected_peers():
+            lm.peer_score.observe(self.peer_manager.scores.score(pid))
+        mesh_sizes = getattr(self.endpoint, "mesh_sizes", None)
+        if mesh_sizes is not None:
+            for topic, size in mesh_sizes().items():
+                lm.gossip_mesh_peers.labels(topic=topic).set(size)
+        self.metrics.beacon.peers.set(len(self.peer_manager.connected_peers()))
 
     def close(self) -> None:
         self.endpoint.close()

@@ -2,10 +2,20 @@
 beacon-node/src/network/peers/{peerManager,score}.ts, simplified to the
 semantics that matter: per-peer score with decay, ban threshold,
 status/metadata tracking, disconnect of banned peers).
+
+ISSUE 15 hardening: bans are a real lifecycle, not just a score
+predicate — ``ban()`` disconnects the peer, evicts its entries from
+BOTH stores (the pre-existing leak: a banned peer stayed in
+``PeerManager.peers`` and ``PeerRpcScoreStore._peers`` forever) and
+time-boxes the ban (`BAN_DURATION_S`); ``maintain()`` runs at the
+network heartbeat to escalate score-banned peers, prune
+long-disconnected entries, and expire old bans.  ``wait_for_peer()``
+lets a Stalled range-sync chain re-arm when connectivity returns
+instead of spinning.
 """
 from __future__ import annotations
 
-import math
+import asyncio
 import time
 from dataclasses import dataclass, field
 from enum import Enum
@@ -25,6 +35,14 @@ MIN_SCORE = -100.0
 DEFAULT_BAN_THRESHOLD = -50.0
 DISCONNECT_THRESHOLD = -20.0
 SCORE_HALFLIFE_S = 600.0
+# lifecycle knobs (peerManager.ts: banned peers are released after a
+# window; disconnected peers' bookkeeping is pruned after a retention)
+BAN_DURATION_S = 600.0
+DISCONNECT_RETENTION_S = 300.0
+
+
+class PeerBannedError(ConnectionError):
+    """Raised when a banned peer tries to (re)connect before unban."""
 
 
 @dataclass
@@ -36,6 +54,7 @@ class PeerInfo:
     metadata: Optional[object] = None    # ssz Metadata
     ping_seq: int = 0
     connected: bool = True
+    disconnected_at: Optional[float] = None
 
 
 class PeerRpcScoreStore:
@@ -65,6 +84,29 @@ class PeerRpcScoreStore:
     def should_disconnect(self, peer_id: str) -> bool:
         return self.score(peer_id) < DISCONNECT_THRESHOLD
 
+    def evict(self, peer_id: str) -> None:
+        """Drop a peer's score entry (ban eviction / disconnect prune).
+        A re-appearing peer starts from a fresh zero score — the ban
+        window itself is what keeps a banned peer out meanwhile."""
+        self._peers.pop(peer_id, None)
+
+    def prune_disconnected(self, cutoff: float) -> List[str]:
+        """Evict entries of peers disconnected at or before ``cutoff``;
+        returns the evicted ids (the store owns its representation —
+        callers must not reach into ``_peers``)."""
+        evicted = [
+            pid
+            for pid, info in self._peers.items()
+            if (
+                not info.connected
+                and info.disconnected_at is not None
+                and info.disconnected_at <= cutoff
+            )
+        ]
+        for pid in evicted:
+            del self._peers[pid]
+        return evicted
+
     def _decay(self, p: PeerInfo) -> None:
         now = self._now()
         dt = now - p.last_update
@@ -74,30 +116,105 @@ class PeerRpcScoreStore:
 
 
 class PeerManager:
-    """Tracks connected peers; periodic ping/status handled by the
-    Network's heartbeat (peerManager.ts)."""
+    """Tracks connected peers; periodic ping/status + maintenance are
+    driven by the Network's heartbeat (peerManager.ts)."""
 
-    def __init__(self, scores: Optional[PeerRpcScoreStore] = None):
-        self.scores = scores or PeerRpcScoreStore()
+    def __init__(self, scores: Optional[PeerRpcScoreStore] = None, now=time.monotonic):
+        self._now = now
+        self.scores = scores or PeerRpcScoreStore(now=now)
         self.peers: Dict[str, PeerInfo] = {}
+        self.banned_until: Dict[str, float] = {}
+        self.bans_total = 0
+        self._peer_event: Optional[asyncio.Event] = None
+        # on_ban(peer_id): the owner severs the transport link — score
+        # bookkeeping alone cannot disconnect a live connection (Network
+        # wires this to the endpoint)
+        self.on_ban: Optional[callable] = None
+
+    # -- connection lifecycle ------------------------------------------
 
     def on_connect(self, peer_id: str) -> PeerInfo:
+        if self.is_banned(peer_id):
+            raise PeerBannedError(f"{peer_id} is banned")
         info = self.scores.peer(peer_id)
         info.connected = True
+        info.disconnected_at = None
         self.peers[peer_id] = info
+        if self._peer_event is not None:
+            self._peer_event.set()
         return info
 
     def on_disconnect(self, peer_id: str) -> None:
         info = self.peers.pop(peer_id, None)
         if info:
             info.connected = False
+            info.disconnected_at = self._now()
+
+    # -- ban lifecycle --------------------------------------------------
+
+    def ban(self, peer_id: str, duration_s: float = BAN_DURATION_S) -> None:
+        """Banned ⇒ disconnected + pruned from both stores, with a
+        time-boxed unban.  Idempotent; re-banning extends the window."""
+        self.on_disconnect(peer_id)
+        self.scores.evict(peer_id)
+        self.banned_until[peer_id] = self._now() + duration_s
+        self.bans_total += 1
+        if self.on_ban is not None:
+            self.on_ban(peer_id)
+
+    def is_banned(self, peer_id: str) -> bool:
+        until = self.banned_until.get(peer_id)
+        if until is not None:
+            if self._now() < until:
+                return True
+            del self.banned_until[peer_id]  # time-boxed unban
+        return self.scores.is_banned(peer_id)
+
+    # -- heartbeat maintenance -----------------------------------------
+
+    def maintain(self, retention_s: float = DISCONNECT_RETENTION_S) -> None:
+        """One maintenance round: escalate score-banned peers into the
+        ban lifecycle, expire old bans, and prune score-store entries of
+        peers disconnected longer than the retention (the unbounded-
+        growth leak: nothing ever removed them)."""
+        for pid in list(self.peers):
+            if self.scores.is_banned(pid):
+                self.ban(pid)
+        now = self._now()
+        for pid in [p for p, t in self.banned_until.items() if t <= now]:
+            del self.banned_until[pid]
+        for pid in self.scores.prune_disconnected(now - retention_s):
+            self.peers.pop(pid, None)
+
+    # -- sync re-arm signal --------------------------------------------
+
+    async def wait_for_peer(self, timeout: Optional[float] = None) -> bool:
+        """Block until a peer (re)connects; returns False on timeout.
+        Used by range sync to re-arm a Stalled chain when peers return
+        instead of spinning.  A connect that happened since the LAST
+        wait is not lost: the event is cleared after a wake, never on
+        entry (no missed-wakeup race)."""
+        if self._peer_event is None:
+            self._peer_event = asyncio.Event()
+        try:
+            await asyncio.wait_for(self._peer_event.wait(), timeout)
+            self._peer_event.clear()
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    # -- views ----------------------------------------------------------
 
     def connected_peers(self) -> List[str]:
-        return [p for p, i in self.peers.items() if i.connected and not self.scores.is_banned(p)]
+        return [
+            p
+            for p, i in self.peers.items()
+            if i.connected and not self.is_banned(p)
+        ]
 
     def best_peers(self, min_head_slot: int = 0) -> List[str]:
         """Peers whose reported head is usable for syncing, best score
-        first."""
+        first (ties broken by peer id, descending — deterministic)."""
         out = []
         for pid in self.connected_peers():
             info = self.peers[pid]

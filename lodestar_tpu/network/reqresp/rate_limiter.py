@@ -28,6 +28,11 @@ class RateLimiterGCRA:
         self._tat[key] = new_tat
         return True
 
+    def __len__(self) -> int:
+        """Tracked keys (per-peer TAT state) — bounded only because the
+        network heartbeat calls prune()."""
+        return len(self._tat)
+
     def prune(self, older_than_ms: float = 60_000) -> None:
         now_ms = self._now() * 1e3
         for k in [k for k, t in self._tat.items() if t < now_ms - older_than_ms]:

@@ -1,13 +1,22 @@
 """ReqResp node: typed request/response over a transport endpoint
 (reference: packages/reqresp/src/ReqResp.ts +
 beacon-node/src/network/reqresp/ReqRespBeaconNode.ts).
+
+ISSUE 15 hardening: client requests pass the ``net.reqresp.request``
+checkpoint and count per-method request/timeout metrics; a timed-out or
+failed request can retry on OTHER peers with a bounded attempt budget
+(``request_any``); the server side passes ``net.reqresp.respond`` (a
+``faults.Delay`` models a stalling responder) and sheds floods through
+the GCRA limiter, reporting the flooder via ``on_rate_limited`` so the
+network layer can penalize it.
 """
 from __future__ import annotations
 
 import asyncio
-from typing import Awaitable, Callable, Dict, List, Optional
+from typing import Awaitable, Callable, Dict, List, Optional, Sequence
 
 from lodestar_tpu.network.transport import Endpoint
+from lodestar_tpu.testing import faults
 from .encoding import (
     RespStatus,
     ReqRespError,
@@ -21,16 +30,38 @@ from .protocols import ALL_PROTOCOLS, BY_ID, Protocol
 from .rate_limiter import RateLimiterGCRA
 
 REQUEST_TIMEOUT_S = 10.0
+MAX_REQUEST_ATTEMPTS = 3  # request_any's cross-peer retry budget
+# GCRA server-side quota: 50 requests / 10 s per (peer, method) — THE
+# default; wrappers (Network, swarm) pass None to inherit it
+DEFAULT_RATE_QUOTA = (50, 10_000)
 
 
 class ReqRespNode:
     """Registers protocol handlers on an Endpoint and offers typed
-    client-side requests with rate limiting and timeouts."""
+    client-side requests with rate limiting, timeouts, and bounded
+    retry-on-another-peer."""
 
-    def __init__(self, endpoint: Endpoint, rate_quota=(50, 10_000)):
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        rate_quota=None,
+        metrics=None,
+        request_timeout: float = REQUEST_TIMEOUT_S,
+        on_rate_limited: Optional[Callable[[str, str], None]] = None,
+    ):
         self.endpoint = endpoint
         self._handlers: Dict[str, Callable] = {}
-        self.rate_limiter = RateLimiterGCRA(*rate_quota)
+        self.rate_limiter = RateLimiterGCRA(*(rate_quota or DEFAULT_RATE_QUOTA))
+        self.request_timeout = request_timeout
+        self._metrics = metrics
+        # on_rate_limited(peer, method): the flood was shed — score it
+        self.on_rate_limited = on_rate_limited
+
+    def _count(self, counter: str, method: str) -> None:
+        if self._metrics is None:
+            return
+        fam = getattr(self._metrics.lodestar, counter)
+        fam.labels(method=method).inc()
 
     # server side ------------------------------------------------------
 
@@ -43,7 +74,21 @@ class ReqRespNode:
 
         async def raw_handler(from_peer: str, protocol_id: str, data: bytes) -> bytes:
             if not self.rate_limiter.allows((from_peer, protocol.method)):
+                self._count("reqresp_rate_limited_total", protocol.method)
+                if self.on_rate_limited is not None:
+                    self.on_rate_limited(from_peer, protocol.method)
                 return encode_error_chunk(RespStatus.INVALID_REQUEST, "rate limited")
+            try:
+                faults.fire(
+                    "net.reqresp.respond",
+                    peer=from_peer,
+                    method=protocol.method,
+                    server=getattr(self.endpoint, "peer_id", None),
+                )
+            except faults.Delay as d:  # stalling responder
+                await asyncio.sleep(d.seconds)
+            except faults.FaultError as e:
+                return encode_error_chunk(RespStatus.SERVER_ERROR, str(e))
             try:
                 req = decode_request(protocol.request_type, data)
             except Exception as e:
@@ -62,13 +107,49 @@ class ReqRespNode:
 
     async def request(
         self, peer: str, protocol: Protocol, request_value=None,
-        timeout: float = REQUEST_TIMEOUT_S,
+        timeout: Optional[float] = None,
     ) -> List[object]:
+        try:
+            faults.fire("net.reqresp.request", peer=peer, method=protocol.method)
+        except faults.Delay as d:  # slow client-side path; failures raise
+            await asyncio.sleep(d.seconds)
+        self._count("reqresp_requests_total", protocol.method)
         data = encode_request(protocol.request_type, request_value)
-        raw = await asyncio.wait_for(
-            self.endpoint.request(peer, protocol.protocol_id, data), timeout
-        )
+        try:
+            raw = await asyncio.wait_for(
+                self.endpoint.request(peer, protocol.protocol_id, data),
+                self.request_timeout if timeout is None else timeout,
+            )
+        except asyncio.TimeoutError:
+            self._count("reqresp_request_timeouts_total", protocol.method)
+            raise
         values, _ = decode_response_chunks(protocol.response_type, raw)
         if protocol.max_response_chunks is not None and len(values) > protocol.max_response_chunks:
             raise ReqRespError(RespStatus.INVALID_REQUEST, "too many chunks")
         return values
+
+    async def request_any(
+        self,
+        peers: Sequence[str],
+        protocol: Protocol,
+        request_value=None,
+        timeout: Optional[float] = None,
+        attempts: int = MAX_REQUEST_ATTEMPTS,
+    ) -> List[object]:
+        """Try ``peers`` in order until one answers, spending at most
+        ``attempts`` requests — the bounded retry-on-another-peer shape
+        a timed-out/failed peer must not stall (reference: ReqResp
+        callers iterate shuffled peer sets with attempt ceilings)."""
+        if not peers:
+            raise ConnectionError("no peers to request from")
+        last_exc: Optional[Exception] = None
+        for i, peer in enumerate(peers[:attempts]):
+            if i > 0:
+                self._count("reqresp_request_retries_total", protocol.method)
+            try:
+                return await self.request(peer, protocol, request_value, timeout)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                last_exc = e
+        raise last_exc  # every attempted peer failed

@@ -3,88 +3,110 @@
 Fills the role of the reference's libp2p stack (TCP transport + noise +
 mplex + gossipsub v1.1: beacon-node/src/network/gossip/gossipsub.ts:77,
 libp2p in package.json:100,113) behind the SAME `Endpoint` surface the
-in-process hub provides (transport.py) — ReqRespNode, Eth2Gossip and
-Network are transport-agnostic, so two OS processes can now discover
-(UDP discv5-like service), dial (this module), range-sync and gossip.
+in-process hub provides (transport.py).
 
-Wire format (after the noise handshake, noise.py):
-    frame   := 4B BE ciphertext length || AEAD(plain)
-    plain   := 1B type || body
-    REQ     := 8B req id || 2B proto len || proto || data
-    RESP_OK / RESP_ERR := 8B req id || data / utf8 error
-    GOSSIP  := 2B topic len || topic || raw message
-    SUB/UNSUB/GRAFT/PRUNE := 2B topic len || topic
-    IHAVE   := 2B topic len || topic || N * 20B message ids
-    IWANT   := 2B topic len || topic || N * 20B message ids
+ISSUE 15 refactor: the gossip mesh, reqresp mux and frame schema moved
+to ``fabric.MeshFabric`` — the pluggable transport seam shared with the
+loopback swarm fabric (loopback.py).  This module is the OS-socket
+binding: listen/dial, the noise handshake, AEAD frame
+encryption/decryption, and the per-connection recv loop.  The TCP frame
+layer is:
 
-Gossip propagation is a degree-limited mesh per topic (gossipsub v1.1
-shape): publishes and first-deliveries forward to mesh peers only;
-heartbeat GRAFTs up to D from known subscribers / PRUNEs beyond D_HIGH,
-and emits IHAVE digests of the recent cache to a sample of non-mesh
-subscribers, who fetch missing messages with IWANT.  Dedup uses the
-spec message-id (gossip.compute_message_id).
+    frame := 4B BE ciphertext length || AEAD(plain)
+
+with ``plain`` as documented in fabric.py.
+
+Sessions are noise-XX by default (noise.py).  ``insecure=True`` swaps in
+a cleartext session with a trivial peer-id-exchange handshake — for
+transport-conformance tests on hosts without the ``cryptography``
+package ONLY (both ends must opt in; an insecure node cannot complete a
+noise handshake).  Production entry points never pass it.
 """
 from __future__ import annotations
 
 import asyncio
-import random
-import time
-from collections import OrderedDict
-from dataclasses import dataclass, field
-from typing import Awaitable, Callable, Dict, List, Optional, Set, Tuple
+import hashlib
+from typing import Optional, Set
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
-
-from . import noise
-from .gossip import compute_message_id
-from .transport import GossipHandler, RequestHandler
+from . import fabric as _fabric
+from .fabric import (  # noqa: F401  (re-exported: frame schema + knobs)
+    HEARTBEAT_S,
+    IHAVE_PEERS,
+    MESH_D,
+    MESH_D_HIGH,
+    MESH_D_LOW,
+    MeshFabric,
+    REQUEST_TIMEOUT_S,
+    _GOSSIP,
+    _GRAFT,
+    _IHAVE,
+    _IWANT,
+    _PRUNE,
+    _REQ,
+    _RESP_ERR,
+    _RESP_OK,
+    _SUB,
+    _UNSUB,
+    _with_topic,
+    _read_topic,
+)
+from lodestar_tpu.testing import faults
 from lodestar_tpu.utils import get_logger
 
 _log = get_logger("wire")
 
-# frame types
-_REQ = 0x01
-_RESP_OK = 0x02
-_RESP_ERR = 0x03
-_GOSSIP = 0x10
-_SUB = 0x15
-_UNSUB = 0x16
-_GRAFT = 0x11
-_PRUNE = 0x12
-_IHAVE = 0x13
-_IWANT = 0x14
-
-# gossipsub-shaped mesh degrees (gossipsub v1.1 defaults)
-MESH_D = 6
-MESH_D_LOW = 4
-MESH_D_HIGH = 10
-IHAVE_PEERS = 3
-HEARTBEAT_S = 1.0
 MAX_FRAME = 1 << 22  # 4 MiB wire cap (> max ssz_snappy block)
-REQUEST_TIMEOUT_S = 10.0
 
-_MSG_ID_LEN = 20
-
-
-def _with_topic(topic: str, rest: bytes = b"") -> bytes:
-    tb = topic.encode()
-    return len(tb).to_bytes(2, "big") + tb + rest
+_PLAIN_MAGIC = b"LTPU-PLAIN/1:"  # insecure handshake hello (32B key follows)
 
 
-def _read_topic(body: bytes) -> Tuple[str, bytes]:
-    n = int.from_bytes(body[:2], "big")
-    return body[2 : 2 + n].decode(), body[2 + n :]
+def _plain_peer_id(pub_raw: bytes) -> str:
+    """Same derivation as noise.peer_id_from_static, duplicated so the
+    insecure mode imports nothing from the cryptography-backed module."""
+    return "16U" + hashlib.sha256(b"lodestar-tpu-peer-id" + pub_raw).hexdigest()[:32]
+
+
+class _PlainSession:
+    """Cleartext stand-in for noise.NoiseSession (insecure mode only)."""
+
+    def __init__(self, remote_static: bytes):
+        self.remote_static = remote_static
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        return plaintext
+
+    def decrypt(self, ciphertext: bytes) -> bytes:
+        return ciphertext
+
+
+async def _plain_initiator(reader, writer, static_pub: bytes) -> _PlainSession:
+    writer.write(_PLAIN_MAGIC + static_pub)
+    await writer.drain()
+    hello = await reader.readexactly(len(_PLAIN_MAGIC) + 32)
+    if not hello.startswith(_PLAIN_MAGIC):
+        raise ConnectionError("peer is not in insecure plaintext mode")
+    return _PlainSession(hello[len(_PLAIN_MAGIC) :])
+
+
+async def _plain_responder(reader, writer, static_pub: bytes) -> _PlainSession:
+    hello = await reader.readexactly(len(_PLAIN_MAGIC) + 32)
+    if not hello.startswith(_PLAIN_MAGIC):
+        raise ConnectionError("peer is not in insecure plaintext mode")
+    writer.write(_PLAIN_MAGIC + static_pub)
+    await writer.drain()
+    return _PlainSession(hello[len(_PLAIN_MAGIC) :])
 
 
 class _Conn:
-    """One encrypted TCP connection to a peer."""
+    """One (optionally encrypted) TCP connection to a peer — the TCP
+    binding's Link (fabric.MeshFabric link contract)."""
 
-    def __init__(self, transport: "WireTransport", reader, writer, session):
+    def __init__(self, transport: "WireTransport", reader, writer, session, peer_id):
         self.transport = transport
         self.reader = reader
         self.writer = writer
         self.session = session
-        self.peer_id = noise.peer_id_from_static(session.remote_static)
+        self.peer_id = peer_id
         self.topics: Set[str] = set()      # remote's subscriptions
         self.pending_reqs: Set[int] = set()  # req ids in flight on this conn
         self._send_lock = asyncio.Lock()
@@ -107,7 +129,7 @@ class _Conn:
                 plain = self.session.decrypt(await self.reader.readexactly(n))
                 if not plain:
                     raise ConnectionError("empty frame")
-                await self.transport._on_frame(self, plain)
+                await self.transport.on_frame(self, plain)
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -118,7 +140,7 @@ class _Conn:
                 f"recv loop ended: {type(e).__name__}: {e}; dropping conn"
             )
         finally:
-            self.transport._drop_conn(self)
+            self.transport.drop_link(self)
 
     def close(self) -> None:
         self.closed = True
@@ -130,42 +152,40 @@ class _Conn:
             _log.debug(f"writer close failed: {type(e).__name__}: {e}")
 
 
-@dataclass
-class _TopicState:
-    handler: GossipHandler
-    mesh: Set[str] = field(default_factory=set)
+class WireTransport(MeshFabric):
+    """Endpoint-compatible transport over real TCP sockets.
 
-
-class WireTransport:
-    """Endpoint-compatible transport over real TCP + noise sessions.
-
-    Implements the surface consumed by ReqRespNode / Eth2Gossip /
-    Network (handle / request / subscribe / unsubscribe / publish /
-    deliver / close) plus listen() / dial() / heartbeat_forever().
+    MeshFabric supplies the Endpoint surface (handle / request /
+    subscribe / unsubscribe / publish / deliver / close) and the mesh
+    heartbeat; this class adds listen() / dial() and the per-connection
+    noise (or insecure-plaintext) sessions.
     """
 
-    def __init__(self, static_priv: Optional[X25519PrivateKey] = None):
-        self.static_priv = static_priv or X25519PrivateKey.generate()
-        pub = self.static_priv.public_key()
-        from cryptography.hazmat.primitives import serialization as _ser
+    def __init__(self, static_priv=None, *, insecure: bool = False):
+        self.insecure = insecure
+        if insecure:
+            import secrets
 
-        self.static_pub = pub.public_bytes(
-            _ser.Encoding.Raw, _ser.PublicFormat.Raw
-        )
-        self.peer_id = noise.peer_id_from_static(self.static_pub)
-        self.conns: Dict[str, _Conn] = {}
-        self.request_handlers: Dict[str, RequestHandler] = {}
-        self._topics: Dict[str, _TopicState] = {}
-        self._pending: Dict[int, asyncio.Future] = {}
-        self._req_counter = 0
+            self.static_priv = None
+            self.static_pub = (
+                static_priv if isinstance(static_priv, bytes) else secrets.token_bytes(32)
+            )
+            peer_id = _plain_peer_id(self.static_pub)
+        else:
+            from cryptography.hazmat.primitives import serialization as _ser
+            from cryptography.hazmat.primitives.asymmetric.x25519 import (
+                X25519PrivateKey,
+            )
+
+            from . import noise
+
+            self.static_priv = static_priv or X25519PrivateKey.generate()
+            self.static_pub = self.static_priv.public_key().public_bytes(
+                _ser.Encoding.Raw, _ser.PublicFormat.Raw
+            )
+            peer_id = noise.peer_id_from_static(self.static_pub)
+        super().__init__(peer_id)
         self._server: Optional[asyncio.AbstractServer] = None
-        self._tasks: Set[asyncio.Task] = set()
-        self._hb_task: Optional[asyncio.Task] = None
-        # recent message cache for IWANT serving + IHAVE digests
-        self._mcache: "OrderedDict[bytes, Tuple[str, bytes]]" = OrderedDict()
-        self._mcache_max = 512
-        self._seen: "OrderedDict[bytes, None]" = OrderedDict()
-        self._seen_max = 1 << 15
         self.listen_port: Optional[int] = None
 
     # -- lifecycle -----------------------------------------------------
@@ -173,302 +193,56 @@ class WireTransport:
     async def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._server = await asyncio.start_server(self._on_accept, host, port)
         self.listen_port = self._server.sockets[0].getsockname()[1]
-        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+        self.start_heartbeat()
         return self.listen_port
 
     async def dial(self, host: str, port: int) -> str:
         """Connect + handshake; returns the remote peer id."""
+        faults.fire("net.transport.connect", src=self.peer_id, dst=f"{host}:{port}")
         reader, writer = await asyncio.open_connection(host, port)
-        session = await noise.initiator_handshake(reader, writer, self.static_priv)
-        return await self._start_conn(reader, writer, session)
+        if self.insecure:
+            session = await _plain_initiator(reader, writer, self.static_pub)
+            peer_id = _plain_peer_id(session.remote_static)
+        else:
+            from . import noise
+
+            session = await noise.initiator_handshake(
+                reader, writer, self.static_priv
+            )
+            peer_id = noise.peer_id_from_static(session.remote_static)
+        return await self._start_conn(reader, writer, session, peer_id)
 
     async def _on_accept(self, reader, writer) -> None:
         try:
-            session = await asyncio.wait_for(
-                noise.responder_handshake(reader, writer, self.static_priv), 5.0
-            )
+            faults.fire("net.transport.connect", src="inbound", dst=self.peer_id)
+            if self.insecure:
+                session = await asyncio.wait_for(
+                    _plain_responder(reader, writer, self.static_pub), 5.0
+                )
+                peer_id = _plain_peer_id(session.remote_static)
+            else:
+                from . import noise
+
+                session = await asyncio.wait_for(
+                    noise.responder_handshake(reader, writer, self.static_priv), 5.0
+                )
+                peer_id = noise.peer_id_from_static(session.remote_static)
         except Exception as e:
             _log.debug(
                 f"inbound handshake failed: {type(e).__name__}: {e}"
             )
             writer.close()
             return
-        await self._start_conn(reader, writer, session)
+        await self._start_conn(reader, writer, session, peer_id)
 
-    async def _start_conn(self, reader, writer, session) -> str:
-        conn = _Conn(self, reader, writer, session)
-        old = self.conns.get(conn.peer_id)
-        if old is not None:
-            old.close()
-        self.conns[conn.peer_id] = conn
+    async def _start_conn(self, reader, writer, session, peer_id) -> str:
+        conn = _Conn(self, reader, writer, session, peer_id)
+        await self.add_link(conn)
         conn._recv_task = asyncio.ensure_future(conn._recv_loop())
-        # announce current subscriptions
-        for topic in self._topics:
-            await conn.send(bytes([_SUB]) + _with_topic(topic))
         return conn.peer_id
 
-    def _drop_conn(self, conn: _Conn) -> None:
-        if self.conns.get(conn.peer_id) is conn:
-            # only the ACTIVE conn's death evicts peer state — a conn
-            # superseded by a reconnect must not wipe the (still valid)
-            # mesh membership of its replacement
-            del self.conns[conn.peer_id]
-            for st in self._topics.values():
-                st.mesh.discard(conn.peer_id)
-        # fail this conn's in-flight requests now instead of letting
-        # callers wait out the 10 s request timeout
-        for rid in list(conn.pending_reqs):
-            fut = self._pending.get(rid)
-            if fut is not None and not fut.done():
-                fut.set_exception(ConnectionError("peer disconnected"))
-        conn.pending_reqs.clear()
-        conn.close()
-
     def close(self) -> None:
-        if self._hb_task:
-            self._hb_task.cancel()
         if self._server:
             self._server.close()
-        for conn in list(self.conns.values()):
-            conn.close()
-        self.conns.clear()
-        for fut in self._pending.values():
-            if not fut.done():
-                fut.set_exception(ConnectionError("transport closed"))
-        self._pending.clear()
-        for t in self._tasks:
-            t.cancel()
-
-    # -- reqresp (Endpoint surface) ------------------------------------
-
-    def handle(self, protocol_id: str, handler: RequestHandler) -> None:
-        self.request_handlers[protocol_id] = handler
-
-    async def request(self, to_peer: str, protocol_id: str, data: bytes) -> bytes:
-        conn = self.conns.get(to_peer)
-        if conn is None:
-            raise ConnectionError(f"not connected to {to_peer}")
-        self._req_counter += 1
-        req_id = self._req_counter
-        fut = asyncio.get_running_loop().create_future()
-        self._pending[req_id] = fut
-        pb = protocol_id.encode()
-        conn.pending_reqs.add(req_id)
-        try:
-            await conn.send(
-                bytes([_REQ])
-                + req_id.to_bytes(8, "big")
-                + len(pb).to_bytes(2, "big")
-                + pb
-                + data
-            )
-            return await asyncio.wait_for(fut, REQUEST_TIMEOUT_S)
-        finally:
-            conn.pending_reqs.discard(req_id)
-            self._pending.pop(req_id, None)
-
-    # -- gossip (Endpoint surface) -------------------------------------
-
-    def subscribe(self, topic: str, handler: GossipHandler) -> None:
-        self._topics[topic] = _TopicState(handler=handler)
-        self._broadcast_control(_SUB, topic)
-
-    def unsubscribe(self, topic: str) -> None:
-        if topic in self._topics:
-            del self._topics[topic]
-            self._broadcast_control(_UNSUB, topic)
-
-    def _broadcast_control(self, ftype: int, topic: str) -> None:
-        for conn in list(self.conns.values()):
-            self._bg(conn.send(bytes([ftype]) + _with_topic(topic)))
-
-    async def publish(self, topic: str, message: bytes) -> int:
-        """Send to mesh peers (or all subscribed peers while the mesh is
-        still forming); returns receiver count."""
-        msg_id = compute_message_id(topic, message)
-        self._remember(topic, msg_id, message)
-        targets = self._forward_targets(topic, exclude=None)
-        frame = bytes([_GOSSIP]) + _with_topic(topic, message)
-        for pid in targets:
-            conn = self.conns.get(pid)
-            if conn:
-                self._bg(conn.send(frame))
-        return len(targets)
-
-    def deliver(self, from_peer: str, topic: str, message: bytes) -> None:
-        st = self._topics.get(topic)
-        if st is None:
-            return
-        self._bg(st.handler(from_peer, topic, message))
-
-    # -- internals -----------------------------------------------------
-
-    def _bg(self, coro: Awaitable) -> None:
-        task = asyncio.ensure_future(coro)
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
-
-    def _remember(self, topic: str, msg_id: bytes, message: bytes) -> None:
-        self._seen[msg_id] = None
-        while len(self._seen) > self._seen_max:
-            self._seen.popitem(last=False)
-        self._mcache[msg_id] = (topic, message)
-        while len(self._mcache) > self._mcache_max:
-            self._mcache.popitem(last=False)
-
-    def _forward_targets(self, topic: str, exclude: Optional[str]) -> List[str]:
-        st = self._topics.get(topic)
-        mesh = set(st.mesh) if st else set()
-        if not mesh:
-            mesh = {p for p, c in self.conns.items() if topic in c.topics}
-        mesh.discard(exclude)
-        return [p for p in mesh if p in self.conns]
-
-    async def _on_frame(self, conn: _Conn, plain: bytes) -> None:
-        ftype, body = plain[0], plain[1:]
-        if ftype == _REQ:
-            req_id = int.from_bytes(body[:8], "big")
-            plen = int.from_bytes(body[8:10], "big")
-            proto = body[10 : 10 + plen].decode()
-            data = body[10 + plen :]
-            self._bg(self._serve_request(conn, req_id, proto, data))
-        elif ftype in (_RESP_OK, _RESP_ERR):
-            req_id = int.from_bytes(body[:8], "big")
-            fut = self._pending.get(req_id)
-            if fut and not fut.done():
-                if ftype == _RESP_OK:
-                    fut.set_result(body[8:])
-                else:
-                    fut.set_exception(
-                        ConnectionError(body[8:].decode(errors="replace"))
-                    )
-        elif ftype == _GOSSIP:
-            topic, message = _read_topic(body)
-            msg_id = compute_message_id(topic, message)
-            if msg_id in self._seen:
-                return
-            self._remember(topic, msg_id, message)
-            self.deliver(conn.peer_id, topic, message)
-            # forward within the mesh (multi-hop propagation)
-            frame = bytes([_GOSSIP]) + _with_topic(topic, message)
-            for pid in self._forward_targets(topic, exclude=conn.peer_id):
-                c = self.conns.get(pid)
-                if c:
-                    self._bg(c.send(frame))
-        elif ftype == _SUB:
-            topic, _ = _read_topic(body)
-            conn.topics.add(topic)
-        elif ftype == _UNSUB:
-            topic, _ = _read_topic(body)
-            conn.topics.discard(topic)
-            st = self._topics.get(topic)
-            if st:
-                st.mesh.discard(conn.peer_id)
-        elif ftype == _GRAFT:
-            topic, _ = _read_topic(body)
-            st = self._topics.get(topic)
-            if st is not None and len(st.mesh) < MESH_D_HIGH:
-                st.mesh.add(conn.peer_id)
-            else:  # not subscribed or mesh full: refuse
-                self._bg(conn.send(bytes([_PRUNE]) + _with_topic(topic)))
-        elif ftype == _PRUNE:
-            topic, _ = _read_topic(body)
-            st = self._topics.get(topic)
-            if st:
-                st.mesh.discard(conn.peer_id)
-        elif ftype == _IHAVE:
-            topic, rest = _read_topic(body)
-            if topic not in self._topics:
-                return
-            want = []
-            for i in range(0, len(rest), _MSG_ID_LEN):
-                mid = rest[i : i + _MSG_ID_LEN]
-                if len(mid) == _MSG_ID_LEN and mid not in self._seen:
-                    want.append(mid)
-            if want:
-                self._bg(
-                    conn.send(bytes([_IWANT]) + _with_topic(topic, b"".join(want)))
-                )
-        elif ftype == _IWANT:
-            topic, rest = _read_topic(body)
-            for i in range(0, len(rest), _MSG_ID_LEN):
-                mid = rest[i : i + _MSG_ID_LEN]
-                entry = self._mcache.get(mid)
-                if entry is not None:
-                    t, message = entry
-                    self._bg(
-                        conn.send(bytes([_GOSSIP]) + _with_topic(t, message))
-                    )
-
-    async def _serve_request(
-        self, conn: _Conn, req_id: int, proto: str, data: bytes
-    ) -> None:
-        handler = self.request_handlers.get(proto)
-        rid = req_id.to_bytes(8, "big")
-        if handler is None:
-            await conn.send(
-                bytes([_RESP_ERR]) + rid + f"unsupported {proto}".encode()
-            )
-            return
-        try:
-            resp = await handler(conn.peer_id, proto, data)
-            await conn.send(bytes([_RESP_OK]) + rid + resp)
-        except Exception as e:
-            if not conn.closed:
-                await conn.send(
-                    bytes([_RESP_ERR]) + rid + str(e)[:256].encode()
-                )
-
-    # -- mesh maintenance ----------------------------------------------
-
-    async def _heartbeat_loop(self) -> None:
-        while True:
-            try:
-                await asyncio.sleep(HEARTBEAT_S)
-                self._heartbeat_once()
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:
-                _log.warn(f"heartbeat failed: {type(e).__name__}: {e}")
-                continue
-
-    def _heartbeat_once(self) -> None:
-        for topic, st in self._topics.items():
-            st.mesh = {p for p in st.mesh if p in self.conns}
-            subscribers = [
-                p for p, c in self.conns.items() if topic in c.topics
-            ]
-            if len(st.mesh) < MESH_D_LOW:
-                candidates = [p for p in subscribers if p not in st.mesh]
-                random.shuffle(candidates)
-                for pid in candidates[: MESH_D - len(st.mesh)]:
-                    st.mesh.add(pid)
-                    conn = self.conns.get(pid)
-                    if conn:
-                        self._bg(conn.send(bytes([_GRAFT]) + _with_topic(topic)))
-            elif len(st.mesh) > MESH_D_HIGH:
-                excess = random.sample(
-                    sorted(st.mesh), len(st.mesh) - MESH_D
-                )
-                for pid in excess:
-                    st.mesh.discard(pid)
-                    conn = self.conns.get(pid)
-                    if conn:
-                        self._bg(conn.send(bytes([_PRUNE]) + _with_topic(topic)))
-            # IHAVE digests of the recent cache to a sample of
-            # subscribers.  Unlike canonical gossipsub this includes
-            # mesh members: a peer GRAFTed after a publish would
-            # otherwise never hear of it (mesh forwards only NEW
-            # messages), and the cost is one id list — IWANT only pulls
-            # unseen ids.
-            ids = [
-                mid for mid, (t, _) in self._mcache.items() if t == topic
-            ][-32:]
-            if ids:
-                sample = list(subscribers)
-                random.shuffle(sample)
-                payload = bytes([_IHAVE]) + _with_topic(topic, b"".join(ids))
-                for pid in sample[: IHAVE_PEERS + len(st.mesh)]:
-                    conn = self.conns.get(pid)
-                    if conn:
-                        self._bg(conn.send(payload))
+            self._server = None
+        super().close()

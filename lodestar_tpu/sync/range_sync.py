@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Set
 
 from lodestar_tpu.params import ACTIVE_PRESET as _p
 from lodestar_tpu.network.peers import PeerAction
+from lodestar_tpu.testing import faults
 from lodestar_tpu.utils import get_logger
 
 _log = get_logger("range-sync")
@@ -33,6 +34,13 @@ EPOCHS_PER_BATCH = 1  # sync/constants.ts:41
 MAX_BATCH_DOWNLOAD_ATTEMPTS = 5  # sync/constants.ts:8
 MAX_BATCH_PROCESSING_ATTEMPTS = 3  # sync/constants.ts:11
 BATCH_BUFFER_SIZE = 5  # concurrent in-flight batches (chain.ts batchBuffer)
+# a peer that served this many INVALID batches is byzantine, not
+# unlucky: Fatal score + lifecycle ban (ISSUE 15 — "routes around
+# byzantine peers instead of stalling")
+INVALID_BATCH_BAN_STRIKES = 2
+# Stalled chains re-arm when a peer (re)connects; cap how long one
+# re-arm wait blocks before surfacing the Stalled result to the caller
+REARM_WAIT_S = 30.0
 
 
 class SyncState(str, Enum):
@@ -82,6 +90,8 @@ class RangeSync:
         self.batch_buffer = batch_buffer
         self.imported = 0
         self._metrics = getattr(chain, "metrics", None)
+        # peer -> count of invalid (processing-failed) batches it served
+        self._invalid_served: Dict[str, int] = {}
 
     def _count_batch(self, status: str) -> None:
         if self._metrics:
@@ -95,25 +105,35 @@ class RangeSync:
                 best = max(best, info.status.head_slot)
         return best
 
-    def _pick_peer(self, batch: Batch, busy: Set[str]) -> Optional[str]:
+    def _pick_peer(self, batch: Batch, busy: Dict[str, int]) -> Optional[str]:
         """Best peer that can serve the batch, avoiding peers that already
-        failed it and peers currently serving another batch (load spread)."""
+        failed it; prefers idle peers, then spreads overflow batches onto
+        the LEAST-loaded peers (always re-picking the single best peer
+        would funnel the whole window through it)."""
         peers = self.network.peer_manager.best_peers(
             min_head_slot=batch.start_slot
         )
         for pid in peers:
-            if pid not in batch.failed_peers and pid not in busy:
+            if pid not in batch.failed_peers and not busy.get(pid):
                 return pid
+        best: Optional[str] = None
         for pid in peers:  # all idle peers failed it: allow busy ones
-            if pid not in batch.failed_peers:
-                return pid
-        return None
+            if pid not in batch.failed_peers and (
+                best is None or busy.get(pid, 0) < busy.get(best, 0)
+            ):
+                best = pid
+        return best
 
     async def _download(self, batch: Batch, pid: str) -> None:
         batch.status = BatchStatus.Downloading
         batch.serving_peer = pid
         batch.download_attempts += 1
         try:
+            faults.fire(
+                "sync.range.batch_download",
+                peer=pid,
+                start_slot=batch.start_slot,
+            )
             blocks = await self.network.blocks_by_range(
                 pid, batch.start_slot, batch.count
             )
@@ -153,9 +173,7 @@ class RangeSync:
             batch.processing_attempts += 1
             if batch.serving_peer is not None:
                 batch.failed_peers.add(batch.serving_peer)
-                self.network.peer_manager.scores.apply_action(
-                    batch.serving_peer, PeerAction.MidToleranceError
-                )
+                self._penalize_invalid_batch(batch.serving_peer)
             batch.blocks = []
             retryable = batch.processing_attempts < MAX_BATCH_PROCESSING_ATTEMPTS
             self._count_batch("retried" if retryable else "failed")
@@ -166,6 +184,45 @@ class RangeSync:
         batch.status = BatchStatus.Done
         self._count_batch("processed")
         return True
+
+    def _penalize_invalid_batch(self, pid: str) -> None:
+        """First invalid batch: tolerance-scored (an honest peer can race
+        a prune).  Repeat offender: Fatal + lifecycle ban — the chain
+        routes around it and it cannot redial until the ban expires."""
+        strikes = self._invalid_served.get(pid, 0) + 1
+        self._invalid_served[pid] = strikes
+        pm = self.network.peer_manager
+        if strikes >= INVALID_BATCH_BAN_STRIKES:
+            pm.scores.apply_action(pid, PeerAction.Fatal)
+            pm.ban(pid)
+            _log.warn(f"banned {pid}: served {strikes} invalid batches")
+        else:
+            pm.scores.apply_action(pid, PeerAction.MidToleranceError)
+
+    async def sync_until_synced(
+        self,
+        max_rounds: int = 10,
+        rearm_wait_s: float = REARM_WAIT_S,
+    ) -> SyncResult:
+        """Drive sync() to completion across Stalled episodes: a Stalled
+        round surfaces, then RE-ARMS when a peer (re)connects — no
+        spinning against an empty peer set, no sleep loops.  Returns the
+        first Synced result, or the last Stalled one when no peer
+        arrives within ``rearm_wait_s`` (or after ``max_rounds``)."""
+        pm = self.network.peer_manager
+        result = await self.sync()
+        for _ in range(max_rounds):
+            if result.state is not SyncState.Stalled:
+                return result
+            # a peer that connected while sync() was finishing must not
+            # be missed (and a Stalled verdict with usable peers — e.g.
+            # after banning byzantine servers — retries on fresh batch
+            # state immediately, bounded by max_rounds)
+            if not pm.connected_peers():
+                if not await pm.wait_for_peer(rearm_wait_s):
+                    return result
+            result = await self.sync()
+        return result
 
     async def sync(self) -> SyncResult:
         batch_slots = EPOCHS_PER_BATCH * _p.SLOTS_PER_EPOCH
@@ -187,6 +244,13 @@ class RangeSync:
                         )
                     )
                 if head_slot >= target and not batches:
+                    # an empty peer set cannot certify "synced" — there
+                    # is no network head to compare against; surface
+                    # Stalled so sync_until_synced re-arms on reconnect
+                    if not self.network.peer_manager.connected_peers():
+                        return SyncResult(
+                            self.imported, head_slot, SyncState.Stalled
+                        )
                     return SyncResult(self.imported, head_slot, SyncState.Synced)
 
                 # extend the batch window up to the buffer size
@@ -212,11 +276,10 @@ class RangeSync:
                     return SyncResult(self.imported, head_slot, SyncState.Stalled)
 
                 # launch downloads for idle batches on distinct peers
-                busy = {
-                    b.serving_peer
-                    for b in batches.values()
-                    if b.status is BatchStatus.Downloading and b.serving_peer
-                }
+                busy: Dict[str, int] = {}
+                for b in batches.values():
+                    if b.status is BatchStatus.Downloading and b.serving_peer:
+                        busy[b.serving_peer] = busy.get(b.serving_peer, 0) + 1
                 launched = False
                 for start in sorted(batches):
                     b = batches[start]
@@ -225,7 +288,7 @@ class RangeSync:
                     pid = self._pick_peer(b, busy)
                     if pid is None:
                         continue
-                    busy.add(pid)
+                    busy[pid] = busy.get(pid, 0) + 1
                     tasks[start] = asyncio.create_task(self._download(b, pid))
                     launched = True
 

@@ -39,6 +39,33 @@ class FaultError(RuntimeError):
     """Default error raised by an armed injection point."""
 
 
+class Drop(FaultError):
+    """Directive: the seam silently discards the unit of work (a wire
+    frame, a gossip message) instead of failing loudly.  Network seams
+    interpret it; elsewhere it behaves like any injected error."""
+
+
+class Delay(FaultError):
+    """Directive: the seam sleeps ``seconds`` then proceeds normally —
+    slow links, stalling responders.  Only meaningful at async seams
+    that declare support (net.transport.write, net.reqresp.respond)."""
+
+    def __init__(self, seconds: float):
+        super().__init__(f"injected delay: {seconds}s")
+        self.seconds = seconds
+
+
+class Garble(FaultError):
+    """Directive: the seam corrupts the payload bytes then proceeds —
+    garbage on the wire that must be absorbed by validation/scoring, not
+    crash the pipeline.  ``mutate(raw) -> bytes`` defaults to a bitwise
+    complement of the payload (deterministic, never a no-op)."""
+
+    def __init__(self, mutate: Optional[Callable[[bytes], bytes]] = None):
+        super().__init__("injected garble")
+        self.mutate = mutate or (lambda raw: bytes(b ^ 0xFF for b in raw))
+
+
 class FaultPlan:
     """One armed point's failure schedule.
 
@@ -51,6 +78,13 @@ class FaultPlan:
 
     With no knob set every call fails (fail-always).  ``error`` is a
     zero-arg factory so each raise gets a fresh exception instance.
+
+    ``match`` scopes the plan to a subset of a point's traffic: it is
+    called with the seam's context kwargs (``match(**ctx) -> bool``) and
+    a non-matching call neither fails nor consumes a schedule index —
+    this is how a single armed ``net.transport.write`` plan partitions
+    specific peer pairs while the rest of the fabric stays healthy.
+    ``match`` runs under the harness lock; keep it cheap and pure.
     """
 
     def __init__(
@@ -61,6 +95,7 @@ class FaultPlan:
         script: Optional[Sequence[bool]] = None,
         every: Optional[int] = None,
         error: Optional[Callable[[], BaseException]] = None,
+        match: Optional[Callable[..., bool]] = None,
     ):
         knobs = sum(x is not None for x in (times, script, every))
         if knobs > 1:
@@ -70,7 +105,8 @@ class FaultPlan:
         self.script = list(script) if script is not None else None
         self.every = every
         self.error = error or (lambda: FaultError(f"injected fault: {point}"))
-        self.calls = 0  # total fire() checks seen
+        self.match = match
+        self.calls = 0  # total fire() checks seen (match-accepted only)
         self.fired = 0  # checks that raised
 
     def _should_fail(self, idx: int) -> bool:
@@ -89,8 +125,9 @@ _ARMED: Dict[str, List[FaultPlan]] = {}
 
 def fire(point: str, **ctx) -> None:
     """Production checkpoint: raise if a test armed ``point`` and its
-    schedule says this call fails.  ``ctx`` is accepted for seam
-    context (method names etc.) and currently unused by schedules."""
+    schedule says this call fails.  ``ctx`` carries seam context (peer
+    ids, topics, method names); plans with a ``match`` predicate only
+    see the calls it accepts — the innermost *matching* plan wins."""
     if not _ARMED:  # fast path: nothing armed anywhere in the process
         return
     # Reviewed exception: only reachable with a fault armed (tests), and
@@ -99,7 +136,13 @@ def fire(point: str, **ctx) -> None:
         plans = _ARMED.get(point)
         if not plans:
             return
-        plan = plans[-1]  # innermost inject() wins
+        plan = None
+        for p in reversed(plans):  # innermost matching inject() wins
+            if p.match is None or p.match(**ctx):
+                plan = p
+                break
+        if plan is None:
+            return
         idx = plan.calls
         plan.calls += 1
         fail = plan._should_fail(idx)
@@ -130,12 +173,16 @@ def inject(
     script: Optional[Sequence[bool]] = None,
     every: Optional[int] = None,
     error: Optional[Callable[[], BaseException]] = None,
+    match: Optional[Callable[..., bool]] = None,
 ):
     """Arm ``point`` for the duration of the block; yields the plan so
     tests can assert on ``plan.calls`` / ``plan.fired``.  Nested
-    injections on the same point stack — the innermost plan is the one
-    consulted until its block exits."""
-    plan = FaultPlan(point, times=times, script=script, every=every, error=error)
+    injections on the same point stack — the innermost plan whose
+    ``match`` accepts the call is the one consulted until its block
+    exits."""
+    plan = FaultPlan(
+        point, times=times, script=script, every=every, error=error, match=match
+    )
     with _lock:
         _ARMED.setdefault(point, []).append(plan)
     try:

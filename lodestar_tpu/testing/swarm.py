@@ -1,0 +1,320 @@
+"""Swarm harness: N in-process beacon nodes on the REAL network pipeline
+(ISSUE 15 / ROADMAP 6).
+
+Each node is a full `Network` — real gossip mesh + v1.1 scoring, real
+reqresp + GCRA rate limiter, real range sync — attached to a
+`MeshFabric` over shared-memory loopback links (network/loopback.py).
+Nothing in the stack knows it is under test: chaos arrives exclusively
+through the deterministic fault checkpoints (`net.transport.*`,
+`net.gossip.*`, `net.reqresp.*`, `sync.range.batch_download`) and
+through byzantine node behaviors scripted here.
+
+Determinism rules (docs/SWARM.md):
+
+* **scripted clock** — every chain shares one `FakeTime`; slots advance
+  by assignment, never by wall time;
+* **manual heartbeats** — mesh maintenance (`heartbeat_fabrics`) and
+  peer maintenance (`heartbeat_networks`) run when the test says so;
+* **no sleeps-as-synchronization** — convergence is awaited with
+  `settle(predicate, ...)`, a bounded poll that fails loudly with the
+  predicate's name instead of silently passing after a lucky sleep;
+* **deterministic fault schedules** — partitions/storms are
+  `faults.inject` plans (times/script/every + `match` over peer ids),
+  so a failure sequence replays exactly.
+
+Swarm size: `n` defaults to the `LODESTAR_TPU_SWARM_N` env var (default
+4 — small, this is a 2-core CI host; scale it up locally to probe
+capacity, ROADMAP 6's nodes×validators metric).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.clock import LocalClock
+from lodestar_tpu.chain.dev import DevChain
+from lodestar_tpu.config import minimal_chain_config as default_cfg
+from lodestar_tpu.db import BeaconDb
+from lodestar_tpu.network.fabric import MeshFabric
+from lodestar_tpu.network.loopback import LoopbackNet
+from lodestar_tpu.network.network import Network
+from lodestar_tpu.state_transition.util.genesis import init_dev_state
+from lodestar_tpu.testing import faults
+from lodestar_tpu.utils import get_logger
+
+_log = get_logger("swarm")
+
+DEFAULT_N = int(os.environ.get("LODESTAR_TPU_SWARM_N", "4"))
+
+
+class FakeTime:
+    """Scripted monotonic clock shared by every node in the swarm."""
+
+    def __init__(self, t0: float = 0.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class _TrustAllVerifier:
+    """BLS stub: swarm chaos targets the network/sync fabric, not
+    signature math (the BLS fault domain has its own chaos suite)."""
+
+    async def verify_signature_sets(self, sets, opts=None):
+        return True
+
+
+class SwarmNode:
+    def __init__(self, idx: int, fabric: MeshFabric, chain: BeaconChain, net: Network):
+        self.idx = idx
+        self.fabric = fabric
+        self.chain = chain
+        self.net = net
+        self.peer_id = fabric.peer_id
+
+    @property
+    def head_slot(self) -> int:
+        return self.chain.fork_choice.get_head().slot
+
+    @property
+    def head_root(self) -> bytes:
+        return self.chain.head_root
+
+
+class Swarm:
+    """N nodes + one DevChain block producer over a loopback fabric."""
+
+    def __init__(self, cfg=default_cfg, validators: int = 8):
+        self.cfg = cfg
+        self.validators = validators
+        self.ft = FakeTime(0.0)
+        self.dev = DevChain(cfg, validators, genesis_time=0)
+        self.tip_slot = 0  # last slot produced on the dev chain
+        self.loopback = LoopbackNet()
+        self.nodes: List[SwarmNode] = []
+        # the interop genesis state is identical for every node and
+        # expensive to rebuild (pure-python BLS pubkey derivation);
+        # compute once and hand each node a serialized clone
+        _, anchor = init_dev_state(cfg, validators, genesis_time=0)
+        self._anchor_type = type(anchor)
+        self._anchor_bytes = self._anchor_type.serialize(anchor)
+
+    # -- construction ---------------------------------------------------
+
+    def add_node(
+        self,
+        request_timeout: float = 1.0,
+        rate_quota=None,  # None -> reqresp.DEFAULT_RATE_QUOTA
+        metrics=None,
+    ) -> SwarmNode:
+        idx = len(self.nodes)
+        fabric = self.loopback.register(
+            MeshFabric(f"swarm-{idx:02d}", request_timeout=request_timeout)
+        )
+        anchor = self._anchor_type.deserialize(self._anchor_bytes)
+        chain = BeaconChain(
+            self.cfg,
+            BeaconDb(),
+            anchor,
+            verifier=_TrustAllVerifier(),
+            clock=LocalClock(0, self.cfg.SECONDS_PER_SLOT, now=self.ft),
+            metrics=metrics,
+        )
+        net = Network(None, chain, chain.db, endpoint=fabric, rate_quota=rate_quota)
+        # swarm chaos uses short reqresp timeouts so stalling-responder
+        # scripts resolve in test time, not the production 10 s
+        net.reqresp.request_timeout = request_timeout
+        node = SwarmNode(idx, fabric, chain, net)
+        self.nodes.append(node)
+        return node
+
+    @classmethod
+    async def create(
+        cls,
+        n: int = DEFAULT_N,
+        validators: int = 8,
+        subscribe: bool = True,
+        request_timeout: float = 1.0,
+        rate_quota=None,  # None -> reqresp.DEFAULT_RATE_QUOTA
+    ) -> "Swarm":
+        """Build a fully-connected, status-handshaked swarm of n nodes."""
+        swarm = cls(validators=validators)
+        for _ in range(n):
+            swarm.add_node(request_timeout=request_timeout, rate_quota=rate_quota)
+        await swarm.connect_full()
+        if subscribe:
+            for node in swarm.nodes:
+                node.net.subscribe_core_topics()
+            swarm.heartbeat_fabrics()
+            await swarm.drain()
+        return swarm
+
+    async def connect_full(self) -> None:
+        for i, a in enumerate(self.nodes):
+            for b in self.nodes[i + 1 :]:
+                await self.connect(a, b)
+
+    async def connect(self, a: SwarmNode, b: SwarmNode) -> None:
+        """Link + mutual status handshake (what two production nodes do
+        after dial)."""
+        await self.loopback.connect(a.fabric, b.fabric)
+        await a.net.connect(b.peer_id)
+        await b.net.connect(a.peer_id)
+
+    def disconnect(self, a: SwarmNode, b: SwarmNode) -> None:
+        self.loopback.disconnect(a.peer_id, b.peer_id)
+        a.net.peer_manager.on_disconnect(b.peer_id)
+        b.net.peer_manager.on_disconnect(a.peer_id)
+
+    def close(self) -> None:
+        for node in self.nodes:
+            node.net.close()
+        self.loopback.close()
+
+    # -- deterministic drivers ------------------------------------------
+
+    def heartbeat_fabrics(self) -> None:
+        """One mesh-maintenance round on every fabric (GRAFT/PRUNE +
+        IHAVE digests) — the scripted form of the 1 s heartbeat loop."""
+        for node in self.nodes:
+            node.fabric._heartbeat_once()
+
+    async def heartbeat_networks(self) -> None:
+        """One peer-maintenance round on every Network (score
+        disconnects/bans, store pruning, rate-limiter prune, metrics)."""
+        for node in self.nodes:
+            await node.net.heartbeat()
+
+    async def drain(self, rounds: int = 3) -> None:
+        """Let in-flight frame pumps and validation queues run. Bounded:
+        each round yields the loop a few times."""
+        for _ in range(rounds * 5):
+            await asyncio.sleep(0)
+        await asyncio.sleep(0.01)
+
+    async def settle(
+        self,
+        predicate: Callable[[], bool],
+        timeout_s: float = 10.0,
+        what: str = "condition",
+        tick: Optional[Callable[[], None]] = None,
+    ) -> None:
+        """Await ``predicate()`` with a bounded poll — the harness's
+        ONLY wait primitive (no bare sleeps in tests).  ``tick`` (e.g.
+        heartbeat_fabrics) runs between polls to drive mesh repair."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while True:
+            if predicate():
+                return
+            if loop.time() >= deadline:
+                raise AssertionError(f"swarm did not settle: {what}")
+            if tick is not None:
+                tick()
+            await asyncio.sleep(0.02)
+
+    # -- block production -----------------------------------------------
+
+    async def advance(
+        self,
+        n_slots: int,
+        publisher: Optional[SwarmNode] = None,
+        import_into: Optional[Sequence[SwarmNode]] = None,
+    ) -> list:
+        """Produce ``n_slots`` blocks on the dev chain.  Each block is
+        either imported directly into ``import_into`` nodes (pre-gossip
+        seeding) or imported+published by ``publisher`` so the swarm
+        receives it over the real mesh."""
+        blocks = []
+        start = self.tip_slot + 1
+        # claim the slot range before the first await so two interleaved
+        # advance() calls cannot produce the same slots
+        self.tip_slot = start + n_slots - 1
+        for slot in range(start, start + n_slots):
+            self.ft.t = slot * self.cfg.SECONDS_PER_SLOT
+            if slot > 1:
+                self.dev.attest(slot - 1)
+            block = self.dev.produce_block(slot)
+            self.dev.import_block(block, verify_signatures=False)
+            targets = import_into if import_into is not None else (
+                [publisher] if publisher is not None else []
+            )
+            for node in targets:
+                await node.chain.process_block(block)
+            if publisher is not None:
+                await publisher.net.publish_block(block)
+            blocks.append(block)
+        return blocks
+
+    # -- chaos scripting ------------------------------------------------
+
+    def partition(self, *groups: Sequence[SwarmNode]):
+        """Context manager: while armed, every wire frame CROSSING the
+        given groups is dropped (both directions, deterministically) —
+        a clean network partition.  Heal by leaving the block."""
+        side: Dict[str, int] = {}
+        for gi, group in enumerate(groups):
+            for node in group:
+                side[node.peer_id] = gi
+
+        def crosses(src=None, dst=None, **_ctx) -> bool:
+            return (
+                src in side and dst in side and side[src] != side[dst]
+            )
+
+        return faults.inject(
+            "net.transport.write", error=faults.Drop, match=crosses
+        )
+
+    def drop_storm(self, every: int = 2):
+        """Context manager: drop every ``every``-th frame fabric-wide —
+        a lossy-network storm that degrades throughput but must never
+        deadlock the pipeline."""
+        return faults.inject(
+            "net.transport.write", every=every, error=faults.Drop
+        )
+
+    def make_byzantine_block_server(self, node: SwarmNode) -> None:
+        """Turn ``node`` into a byzantine batch server: its
+        beacon_blocks_by_range handler serves structurally valid blocks
+        whose state roots are garbage — they decode fine and fail
+        processing, the worst case for a syncing peer."""
+        from lodestar_tpu.network.reqresp.protocols import BEACON_BLOCKS_BY_RANGE
+
+        async def evil_blocks_by_range(from_peer, req):
+            out = []
+            for slot in range(req.start_slot, req.start_slot + req.count * req.step, req.step):
+                blk = node.net._block_at_slot(slot)
+                if blk is not None:
+                    bad = type(blk).deserialize(type(blk).serialize(blk))
+                    bad.message.state_root = b"\xde" * 32
+                    out.append(bad)
+            return out
+
+        node.net.reqresp.register_handler(
+            BEACON_BLOCKS_BY_RANGE, evil_blocks_by_range
+        )
+
+    # -- views ----------------------------------------------------------
+
+    def heads(self) -> List[bytes]:
+        return [node.head_root for node in self.nodes]
+
+    def converged(self, nodes: Optional[Sequence[SwarmNode]] = None) -> bool:
+        nodes = list(nodes if nodes is not None else self.nodes)
+        return len({node.head_root for node in nodes}) == 1
+
+    def mesh_connected_across(
+        self, topic: str, group_a: Sequence[SwarmNode], group_b: Sequence[SwarmNode]
+    ) -> bool:
+        """True if at least one mesh edge crosses the two groups for
+        ``topic`` (the partition-heal mesh re-convergence check)."""
+        b_ids: Set[str] = {n.peer_id for n in group_b}
+        for node in group_a:
+            st = node.fabric._topics.get(topic)
+            if st and st.mesh & b_ids:
+                return True
+        return False

@@ -135,6 +135,7 @@ _FAST_FILES = {
     "test_ops_tooling.py",
     "test_optimistic_sync.py",
     "test_subnets.py",
+    "test_swarm.py",
 }
 
 def pytest_collection_modifyitems(config, items):

@@ -126,6 +126,35 @@ _PINNED_EL_SERIES = {
 }
 
 
+# Network-fault-domain series (ISSUE 15): a swarm losing mesh edges, a
+# peer set walking into timeouts/retries, or a flood being shed must be
+# VISIBLE on the shipped gossip + range-sync boards.
+_PINNED_NET_SERIES = {
+    "lodestar_tpu_gossip_mesh_peers": "lodestar_tpu_gossip.json",
+    "lodestar_tpu_reqresp_rate_limited_total": "lodestar_tpu_gossip.json",
+    "lodestar_tpu_reqresp_requests_total": "lodestar_tpu_range_sync.json",
+    "lodestar_tpu_reqresp_request_timeouts_total": "lodestar_tpu_range_sync.json",
+    "lodestar_tpu_reqresp_request_retries_total": "lodestar_tpu_range_sync.json",
+    "lodestar_tpu_peer_score": "lodestar_tpu_range_sync.json",
+}
+
+
+def test_network_dashboards_pin_fault_domain_series():
+    exported_bases = {_base(n) for n in _exported_names()}
+    for series, dash_name in _PINNED_NET_SERIES.items():
+        dash = json.load(open(os.path.join(_DASH_DIR, dash_name)))
+        targeted = set()
+        for panel in dash.get("panels", []):
+            for target in panel.get("targets", []):
+                targeted.update(_METRIC_RE.findall(target.get("expr", "")))
+        targeted_bases = {_base(n) for n in targeted}
+        assert series in targeted or _base(series) in targeted_bases, (
+            f"{dash_name} lost its {series} panel"
+        )
+        # and the exporter really exports it (both directions pinned)
+        assert _base(series) in exported_bases, f"{series} not exported"
+
+
 def test_execution_el_dashboard_pins_engine_and_eth1_series():
     path = os.path.join(_DASH_DIR, "lodestar_tpu_execution_el.json")
     dash = json.load(open(path))

@@ -100,6 +100,51 @@ class TestHarness:
             assert faults.active() == ["a.b", "c.d"]
         assert faults.active() == []
 
+    def test_match_scopes_plan_to_accepted_ctx(self):
+        """A match predicate filters calls by seam context; rejected
+        calls neither fail nor consume schedule indices (ISSUE 15 —
+        this is how one armed write seam partitions peer pairs)."""
+        with faults.inject(
+            "p.match", times=1, match=lambda peer=None, **_: peer == "bad"
+        ) as plan:
+            faults.fire("p.match", peer="good")  # rejected: no index burn
+            with pytest.raises(faults.FaultError):
+                faults.fire("p.match", peer="bad")  # consumes times=1
+            faults.fire("p.match", peer="bad")  # schedule exhausted
+        assert plan.calls == 2 and plan.fired == 1
+
+    def test_innermost_matching_plan_wins(self):
+        """Stacked plans with disjoint matches coexist on one point —
+        a partition plan and a storm plan, for example."""
+        with faults.inject(
+            "p.multi", match=lambda peer=None, **_: peer == "a"
+        ) as plan_a:
+            with faults.inject(
+                "p.multi", match=lambda peer=None, **_: peer == "b"
+            ) as plan_b:
+                with pytest.raises(faults.FaultError):
+                    faults.fire("p.multi", peer="a")  # falls past inner
+                with pytest.raises(faults.FaultError):
+                    faults.fire("p.multi", peer="b")  # inner takes it
+                faults.fire("p.multi", peer="c")  # nobody matches
+        assert plan_a.fired == 1 and plan_b.fired == 1
+
+    def test_directive_errors_carry_their_payloads(self):
+        with pytest.raises(faults.Delay) as ei:
+            with faults.inject("p.delay", error=lambda: faults.Delay(1.5)):
+                faults.fire("p.delay")
+        assert ei.value.seconds == 1.5
+        with pytest.raises(faults.Garble) as ei:
+            with faults.inject("p.garble", error=faults.Garble):
+                faults.fire("p.garble")
+        # default mutation: deterministic, never a no-op
+        assert ei.value.mutate(b"\x00\xff") == b"\xff\x00"
+        # directives are FaultErrors, so unaware seams treat them as
+        # ordinary injected failures
+        assert issubclass(faults.Drop, faults.FaultError)
+        assert issubclass(faults.Delay, faults.FaultError)
+        assert issubclass(faults.Garble, faults.FaultError)
+
 
 # ---------------------------------------------------------------------------
 # degradation ladder (tentpole a)

@@ -1,5 +1,7 @@
 """Gossipsub v1.1 peer scoring (reference:
-network/gossip/scoringParameters.ts).
+network/gossip/scoringParameters.ts) + rpc peer-score threshold edges
+(ISSUE 15: these thresholds now gate swarm chaos outcomes — partition
+bans, byzantine quarantine — so the edges are pinned here).
 """
 from lodestar_tpu.network.gossip_scoring import (
     FIRST_DELIVERY_CAP,
@@ -7,6 +9,23 @@ from lodestar_tpu.network.gossip_scoring import (
     GossipPeerScore,
     _topic_kind,
 )
+from lodestar_tpu.network.peers import (
+    DEFAULT_BAN_THRESHOLD,
+    DISCONNECT_THRESHOLD,
+    MIN_SCORE,
+    PeerAction,
+    PeerManager,
+    PeerRpcScoreStore,
+    SCORE_HALFLIFE_S,
+)
+
+
+class _FakeTime:
+    def __init__(self, t0=0.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
 
 TOPIC_BLOCK = "/eth2/01020304/beacon_block/ssz_snappy"
 TOPIC_ATT_7 = "/eth2/01020304/beacon_attestation_7/ssz_snappy"
@@ -66,3 +85,74 @@ def test_behaviour_penalty_quadratic_past_threshold():
     assert s.score("p") == 0.0  # below threshold: no penalty
     s.on_behaviour_penalty("p")
     assert s.score("p") < 0.0
+
+
+def test_gossip_decay_prunes_emptied_peers():
+    """decay() must eventually delete a silent peer's whole entry — the
+    registry would otherwise grow with lifetime peer churn."""
+    s = GossipPeerScore()
+    s.on_invalid_message("churned", TOPIC_BLOCK)
+    s.on_behaviour_penalty("churned")
+    assert "churned" in s._peers
+    for _ in range(600):
+        s.decay()
+    assert "churned" not in s._peers
+
+
+# ---------------------------------------------------------------------------
+# rpc peer-score edges (network/peers.py) — these thresholds gate the
+# swarm chaos outcomes, so pin them exactly
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_score_decays_upward_across_thresholds():
+    """A peer sitting just past disconnect/ban must cross BACK over the
+    thresholds as decay pulls the score toward zero."""
+    t = _FakeTime(0.0)
+    s = PeerRpcScoreStore(now=t)
+    for _ in range(6):
+        s.apply_action("p", PeerAction.LowToleranceError)  # -60
+    assert s.is_banned("p") and s.should_disconnect("p")
+    t.t += SCORE_HALFLIFE_S  # -60 -> -30: unbanned, still disconnectable
+    assert not s.is_banned("p")
+    assert s.should_disconnect("p")
+    t.t += SCORE_HALFLIFE_S  # -30 -> -15: usable again
+    assert not s.should_disconnect("p")
+    assert s.score("p") < 0.0
+
+
+def test_rpc_score_clamps_at_min_score():
+    s = PeerRpcScoreStore(now=_FakeTime(0.0))
+    for _ in range(5):
+        s.apply_action("p", PeerAction.Fatal)
+    assert s.score("p") == MIN_SCORE
+    # thresholds stay ordered: MIN < ban < disconnect < 0
+    assert MIN_SCORE < DEFAULT_BAN_THRESHOLD < DISCONNECT_THRESHOLD < 0
+
+
+def test_best_peers_orders_by_score_then_deterministic_tiebreak():
+    t = _FakeTime(0.0)
+    pm = PeerManager(now=t)
+    for pid in ("pa", "pb", "pc"):
+        pm.on_connect(pid)
+    pm.scores.apply_action("pa", PeerAction.HighToleranceError)  # -1
+    order = pm.best_peers()
+    # pb/pc tie at 0.0 -> deterministic peer-id (desc) tiebreak, then pa
+    assert order == ["pc", "pb", "pa"]
+    # equal scores always produce the same order on repeat calls
+    assert pm.best_peers() == order
+
+
+def test_best_peers_filters_by_head_slot_and_ban():
+    t = _FakeTime(0.0)
+    pm = PeerManager(now=t)
+
+    class _Status:
+        def __init__(self, head_slot):
+            self.head_slot = head_slot
+
+    pm.on_connect("low").status = _Status(5)
+    pm.on_connect("high").status = _Status(50)
+    pm.on_connect("banned").status = _Status(50)
+    pm.ban("banned")
+    assert pm.best_peers(min_head_slot=10) == ["high"]

@@ -1,0 +1,604 @@
+"""Network fault domain: deterministic swarm chaos + transport-seam
+conformance (ISSUE 15 / ROADMAP 6).
+
+Every scenario here runs the REAL pipeline — MeshFabric gossip mesh +
+scoring, reqresp + GCRA limiter, range sync — over in-process loopback
+links, with chaos arriving only through `faults.inject()` scripts and
+byzantine node behaviors.  No sleeps-as-synchronization: convergence is
+awaited with `Swarm.settle(predicate)`, and mesh/peer heartbeats are
+driven explicitly.
+
+The transport-conformance tests pin ROADMAP 6's refactor unlock: the
+loopback and OS-socket bindings of the seam behave identically under
+the same suite (the noise flavor auto-skips on hosts without the
+`cryptography` package, like this CI container).
+"""
+import asyncio
+
+import pytest
+
+import time
+
+from lodestar_tpu.network.fabric import MeshFabric
+from lodestar_tpu.network.gossip import GossipType
+from lodestar_tpu.network.loopback import LoopbackNet
+from lodestar_tpu.network.peers import (
+    BAN_DURATION_S,
+    PeerAction,
+    PeerBannedError,
+    PeerManager,
+)
+from lodestar_tpu.network.reqresp import RateLimiterGCRA
+from lodestar_tpu.network.reqresp.encoding import ReqRespError
+from lodestar_tpu.network.reqresp.protocols import PING
+from lodestar_tpu.params import ACTIVE_PRESET as _p, ACTIVE_PRESET_NAME
+from lodestar_tpu.sync.range_sync import RangeSync, SyncState
+from lodestar_tpu.testing import faults
+from lodestar_tpu.testing.swarm import FakeTime, Swarm
+
+pytestmark = pytest.mark.skipif(
+    ACTIVE_PRESET_NAME != "minimal", reason="minimal preset only"
+)
+
+E = _p.SLOTS_PER_EPOCH
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.reset()
+
+
+def run(coro):
+    loop = asyncio.get_event_loop_policy().new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# transport-seam conformance: one suite, every binding
+# ---------------------------------------------------------------------------
+
+TRANSPORTS = ["loopback", "tcp-plain", "tcp-noise"]
+
+
+async def _make_line(flavor):
+    """Three endpoints in a line topology a-b-c; returns (a, b, c, close)."""
+    if flavor == "loopback":
+        net = LoopbackNet()
+        a, b, c = (net.register(MeshFabric(f"conf-{i}")) for i in range(3))
+        await net.connect(a, b)
+        await net.connect(b, c)
+        return a, b, c, net.close
+    if flavor == "tcp-noise":
+        pytest.importorskip("cryptography")
+    from lodestar_tpu.network.wire import WireTransport
+
+    insecure = flavor == "tcp-plain"
+    a, b, c = (WireTransport(insecure=insecure) for _ in range(3))
+    for t in (a, b, c):
+        await t.listen()
+    await a.dial("127.0.0.1", b.listen_port)
+    await c.dial("127.0.0.1", b.listen_port)
+    # let b's accept side register both conns
+    for _ in range(50):
+        await asyncio.sleep(0.01)
+        if a.peer_id in b.conns and c.peer_id in b.conns:
+            break
+
+    def close():
+        for t in (a, b, c):
+            t.close()
+
+    return a, b, c, close
+
+
+@pytest.mark.parametrize("flavor", TRANSPORTS)
+def test_transport_conformance_reqresp(flavor):
+    async def go():
+        a, b, c, close = await _make_line(flavor)
+        try:
+            async def echo(from_peer, proto, data):
+                return b"echo:" + data
+
+            async def boom(from_peer, proto, data):
+                raise ValueError("nope")
+
+            b.handle("/conf/echo", echo)
+            b.handle("/conf/boom", boom)
+            assert await a.request(b.peer_id, "/conf/echo", b"hi") == b"echo:hi"
+            assert await c.request(b.peer_id, "/conf/echo", b"yo") == b"echo:yo"
+            with pytest.raises(ConnectionError):
+                await a.request(b.peer_id, "/conf/boom", b"")
+            with pytest.raises(ConnectionError):
+                await a.request(b.peer_id, "/conf/unknown", b"")
+            # no link at all
+            with pytest.raises(ConnectionError):
+                await a.request("nobody", "/conf/echo", b"")
+        finally:
+            close()
+
+    run(go())
+
+
+@pytest.mark.parametrize("flavor", TRANSPORTS)
+def test_transport_conformance_gossip_multihop(flavor):
+    async def go():
+        a, b, c, close = await _make_line(flavor)
+        try:
+            got = {"a": [], "b": [], "c": []}
+
+            def handler(key):
+                async def h(from_peer, topic, raw):
+                    got[key].append(raw)
+
+                return h
+
+            topic = "/eth2/00000000/beacon_block/ssz_snappy"
+            from lodestar_tpu.utils.snappy import compress
+
+            for key, t in (("a", a), ("b", b), ("c", c)):
+                t.subscribe(topic, handler(key))
+            for _ in range(20):
+                await asyncio.sleep(0.01)
+            for t in (a, b, c):
+                t._heartbeat_once()
+            await asyncio.sleep(0.05)
+            msg = compress(b"conformance block")
+            await a.publish(topic, msg)
+            for _ in range(100):
+                await asyncio.sleep(0.01)
+                if got["c"]:
+                    break
+            assert got["b"] == [msg]
+            assert got["c"] == [msg], f"{flavor}: no multi-hop via b"
+        finally:
+            close()
+
+    run(go())
+
+
+@pytest.mark.parametrize("flavor", TRANSPORTS)
+def test_transport_conformance_drop_fails_pending_requests(flavor):
+    """A dead link must fail in-flight requests immediately — waiting
+    out the full request timeout would stall sync for 10 s per loss."""
+
+    async def go():
+        a, b, c, close = await _make_line(flavor)
+        try:
+            async def stall(from_peer, proto, data):
+                await asyncio.sleep(3600)
+                return b""
+
+            b.handle("/conf/stall", stall)
+            req = asyncio.ensure_future(
+                a.request(b.peer_id, "/conf/stall", b"")
+            )
+            for _ in range(20):
+                await asyncio.sleep(0.01)
+                if b.peer_id in a.conns and a.conns[b.peer_id].pending_reqs:
+                    break
+            a.drop_link(a.conns[b.peer_id])
+            with pytest.raises(ConnectionError):
+                await asyncio.wait_for(req, 2.0)
+        finally:
+            close()
+
+    run(go())
+
+
+def test_loopback_reconnect_supersedes_and_fails_pending():
+    """Binding parity: a re-connect replaces the old link AND fails its
+    in-flight requests at once (the TCP recv loop gives this as a side
+    effect; the fabric now guarantees it for every binding)."""
+
+    async def go():
+        net = LoopbackNet()
+        a = net.register(MeshFabric("re-a", request_timeout=5.0))
+        b = net.register(MeshFabric("re-b"))
+        await net.connect(a, b)
+
+        async def stall(from_peer, proto, data):
+            await asyncio.sleep(3600)
+            return b""
+
+        b.handle("/re/stall", stall)
+        req = asyncio.ensure_future(a.request("re-b", "/re/stall", b""))
+        for _ in range(50):
+            await asyncio.sleep(0.01)
+            if a.conns["re-b"].pending_reqs:
+                break
+        old_link = a.conns["re-b"]
+        await net.connect(a, b)  # supersede
+        assert a.conns["re-b"] is not old_link
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(req, 1.0)
+        net.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# chaos: partition -> heal re-convergence
+# ---------------------------------------------------------------------------
+
+
+def test_partition_heals_mesh_and_heads():
+    async def go():
+        swarm = await Swarm.create(4)
+        try:
+            left, right = swarm.nodes[:2], swarm.nodes[2:]
+            await swarm.advance(2, publisher=swarm.nodes[0])
+            await swarm.settle(
+                lambda: swarm.converged(), what="pre-partition convergence",
+                tick=swarm.heartbeat_fabrics,
+            )
+
+            with swarm.partition(left, right) as plan:
+                await swarm.advance(3, publisher=swarm.nodes[0])
+                await swarm.settle(
+                    lambda: swarm.converged(left),
+                    what="left side converges during partition",
+                    tick=swarm.heartbeat_fabrics,
+                )
+                assert not swarm.converged(), "partition leaked frames"
+                assert plan.fired > 0, "partition script never dropped a frame"
+
+            # heal: heartbeats re-advertise via IHAVE, the right side
+            # IWANTs the missed blocks and resolves ancestry
+            await swarm.settle(
+                lambda: swarm.converged(),
+                timeout_s=15,
+                what="post-heal head re-convergence",
+                tick=swarm.heartbeat_fabrics,
+            )
+            assert all(n.head_slot == 5 for n in swarm.nodes)
+            # mesh re-convergence: at least one mesh edge crosses the
+            # old partition boundary again for the block topic
+            topic = swarm.nodes[0].net.gossip._topic(GossipType.beacon_block)
+            await swarm.settle(
+                lambda: swarm.mesh_connected_across(topic, left, right),
+                what="mesh edges cross the healed boundary",
+                tick=swarm.heartbeat_fabrics,
+            )
+        finally:
+            swarm.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# chaos: lagging node range-syncs past byzantine batch servers
+# ---------------------------------------------------------------------------
+
+
+def test_lagging_node_catches_up_past_byzantine_peers():
+    async def go():
+        swarm = await Swarm.create(3, subscribe=False)
+        try:
+            await swarm.advance(5 * E, import_into=swarm.nodes)
+            honest, byz = swarm.nodes[:1], swarm.nodes[1:3]
+            for n in byz:
+                swarm.make_byzantine_block_server(n)
+
+            lag = swarm.add_node()
+            for n in honest + byz:
+                await swarm.connect(lag, n)
+
+            rs = RangeSync(lag.net, lag.chain, batch_buffer=8)
+            result = await rs.sync_until_synced()
+
+            assert result.state == SyncState.Synced
+            assert lag.head_slot == 5 * E
+            assert lag.head_root == honest[0].head_root
+            pm = lag.net.peer_manager
+            for n in byz:
+                assert pm.is_banned(n.peer_id), (
+                    f"byzantine {n.peer_id} not banned "
+                    f"(strikes={rs._invalid_served})"
+                )
+                assert n.peer_id not in pm.peers, "ban did not evict peer entry"
+                assert n.peer_id not in pm.scores._peers, (
+                    "ban did not evict score-store entry"
+                )
+                assert n.peer_id not in lag.fabric.conns, (
+                    "ban did not sever the live transport link"
+                )
+            for n in honest:
+                assert not pm.is_banned(n.peer_id)
+            # banned peers are refused on reconnect until the window ends
+            with pytest.raises(PeerBannedError):
+                pm.on_connect(byz[0].peer_id)
+        finally:
+            swarm.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# chaos: drop storm degrades throughput but never deadlocks
+# ---------------------------------------------------------------------------
+
+
+def test_drop_storm_degrades_but_never_deadlocks():
+    async def go():
+        swarm = await Swarm.create(4)
+        try:
+            await swarm.advance(1, publisher=swarm.nodes[0])
+            await swarm.settle(
+                lambda: swarm.converged(), what="pre-storm convergence",
+                tick=swarm.heartbeat_fabrics,
+            )
+
+            with swarm.drop_storm(every=2) as plan:
+                # publishes must complete even while half the frames die
+                await swarm.advance(3, publisher=swarm.nodes[0])
+                # reqresp stays live: answers arrive or time out, the
+                # loop never wedges
+                peer = swarm.nodes[1].peer_id
+                try:
+                    await swarm.nodes[0].net.reqresp.request(
+                        peer, PING, 1, timeout=0.5
+                    )
+                except (asyncio.TimeoutError, ConnectionError, ReqRespError):
+                    pass  # shedding under loss is fine; deadlock is not
+                assert plan.fired > 0, "storm script never dropped a frame"
+
+            # storm over: the next clean block's ancestry walk + the
+            # heartbeat IHAVE/IWANT repair converge the swarm.  (A block
+            # delivered mid-storm whose by-root ancestor fetch was ALSO
+            # lost stays seen-cached — exactly like production gossipsub
+            # — so healing rides the next publication, not a re-send.)
+            await swarm.advance(1, publisher=swarm.nodes[0])
+            await swarm.settle(
+                lambda: swarm.converged() and swarm.nodes[0].head_slot == 5,
+                timeout_s=15,
+                what="post-storm convergence",
+                tick=swarm.heartbeat_fabrics,
+            )
+        finally:
+            swarm.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# chaos: reqresp flood shed by the GCRA limiter, flooder penalized
+# ---------------------------------------------------------------------------
+
+
+def test_reqresp_flood_shed_and_flooder_penalized():
+    async def go():
+        from prometheus_client import CollectorRegistry
+
+        from lodestar_tpu.metrics import Metrics
+
+        swarm = Swarm()
+        try:
+            metrics = Metrics(registry=CollectorRegistry())
+            victim = swarm.add_node(rate_quota=(5, 1_000), metrics=metrics)
+            flooder = swarm.add_node()
+            await swarm.connect(victim, flooder)
+
+            shed = 0
+            for _ in range(20):
+                try:
+                    await flooder.net.reqresp.request(victim.peer_id, PING, 1)
+                except ReqRespError:
+                    shed += 1
+            assert shed >= 10, f"flood was not shed (only {shed}/20)"
+
+            # the victim counted the sheds and penalized the flooder on
+            # both score registers
+            assert (
+                metrics.registry.get_sample_value(
+                    "lodestar_tpu_reqresp_rate_limited_total",
+                    {"method": "ping"},
+                )
+                >= shed
+            )
+            assert victim.net.peer_manager.scores.score(flooder.peer_id) < 0
+            assert (
+                victim.net.gossip.peer_score._peer(
+                    flooder.peer_id
+                ).behaviour_penalty
+                > 0
+            )
+        finally:
+            swarm.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# chaos: garbled gossip payloads are absorbed and quarantine the sender
+# ---------------------------------------------------------------------------
+
+
+def test_garbled_gossip_payloads_quarantine_sender():
+    async def go():
+        swarm = await Swarm.create(3)
+        try:
+            evil = swarm.nodes[2]
+            victim = swarm.nodes[0]
+
+            def from_evil(peer=None, **_ctx):
+                return peer == evil.peer_id
+
+            with faults.inject(
+                "net.gossip.deliver", error=faults.Garble, match=from_evil
+            ) as plan:
+                # 18 garbled blocks push the v1.1 invalid-message term
+                # past the graylist threshold (0.5 * -99 * 18^2)
+                await swarm.advance(18, publisher=evil)
+                await swarm.settle(
+                    lambda: victim.net.gossip.peer_score.should_graylist(
+                        evil.peer_id
+                    ),
+                    what="garbling peer graylisted",
+                )
+                assert plan.fired >= 18
+            assert victim.net.gossip.stats.invalid >= 18
+            # quarantine escalates to a lifecycle ban at the heartbeat
+            await swarm.heartbeat_networks()
+            assert victim.net.peer_manager.is_banned(evil.peer_id)
+            assert evil.peer_id not in victim.net.peer_manager.peers
+        finally:
+            swarm.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# hardening: reqresp timeout -> bounded retry on another peer
+# ---------------------------------------------------------------------------
+
+
+def test_request_any_retries_on_another_peer():
+    async def go():
+        swarm = Swarm()
+        try:
+            client = swarm.add_node(request_timeout=0.3)
+            staller = swarm.add_node()
+            healthy = swarm.add_node()
+            await swarm.connect(client, staller)
+            await swarm.connect(client, healthy)
+
+            def staller_stalls(server=None, **_ctx):
+                return server == staller.peer_id
+
+            # the stalling responder holds the request past the client's
+            # timeout; request_any must time out and retry on the
+            # healthy peer within its bounded attempt budget
+            with faults.inject(
+                "net.reqresp.respond",
+                error=lambda: faults.Delay(5.0),
+                match=staller_stalls,
+            ) as plan:
+                with pytest.raises(asyncio.TimeoutError):
+                    await client.net.reqresp.request(
+                        staller.peer_id, PING, 1, timeout=0.3
+                    )
+                out = await client.net.reqresp.request_any(
+                    [staller.peer_id, healthy.peer_id], PING, 1, timeout=0.3
+                )
+                assert out == [0]
+                assert plan.fired == 2, "stall script did not cover both tries"
+        finally:
+            swarm.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# hardening: a Stalled chain re-arms when peers return
+# ---------------------------------------------------------------------------
+
+
+def test_stalled_range_sync_rearms_when_peer_returns():
+    async def go():
+        swarm = Swarm()
+        try:
+            server = swarm.add_node()
+            await swarm.advance(2 * E, import_into=[server])
+            lonely = swarm.add_node()
+
+            rs = RangeSync(lonely.net, lonely.chain)
+            # no peers at all: one round surfaces Stalled immediately
+            first = await rs.sync()
+            assert first.state == SyncState.Stalled
+
+            async def connect_later():
+                await asyncio.sleep(0.05)
+                await swarm.connect(lonely, server)
+
+            task = asyncio.ensure_future(connect_later())
+            result = await rs.sync_until_synced(rearm_wait_s=5.0)
+            await task
+            assert result.state == SyncState.Synced
+            assert lonely.head_slot == 2 * E
+        finally:
+            swarm.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: peer-store leak, ban lifecycle, limiter pruning
+# ---------------------------------------------------------------------------
+
+
+def test_ban_evicts_both_stores_and_unbans_after_window():
+    t = FakeTime(1_000.0)
+    pm = PeerManager(now=t)
+    pm.on_connect("p1")
+    pm.scores.apply_action("p1", PeerAction.Fatal)
+    pm.ban("p1")
+    assert "p1" not in pm.peers, "banned peer leaked in PeerManager.peers"
+    assert "p1" not in pm.scores._peers, "banned peer leaked in score store"
+    assert pm.is_banned("p1")
+    with pytest.raises(PeerBannedError):
+        pm.on_connect("p1")
+    # time-boxed unban
+    t.t += BAN_DURATION_S + 1
+    assert not pm.is_banned("p1")
+    info = pm.on_connect("p1")
+    assert info.connected and pm.scores.score("p1") == 0.0
+
+
+def test_long_disconnected_peers_pruned_at_maintain():
+    t = FakeTime(0.0)
+    pm = PeerManager(now=t)
+    pm.on_connect("gone")
+    pm.on_connect("stays")
+    pm.on_disconnect("gone")
+    pm.maintain()
+    assert "gone" in pm.scores._peers, "pruned before retention elapsed"
+    t.t += 301.0
+    pm.maintain()
+    assert "gone" not in pm.scores._peers, (
+        "disconnected peer never pruned from score store (the leak)"
+    )
+    assert "stays" in pm.scores._peers
+
+
+def test_heartbeat_prunes_rate_limiter_and_readmits_full_burst():
+    t = FakeTime(0.0)
+    rl = RateLimiterGCRA(5, 1_000, now=t)
+    for _ in range(5):
+        assert rl.allows("peer-a")
+    assert not rl.allows("peer-a")  # burst exhausted
+    assert len(rl) == 1
+    t.t += 120.0  # window long gone
+    rl.prune()
+    assert len(rl) == 0, "prune left stale TAT state"
+    # a pruned key re-admits at FULL burst, not a partial residue
+    allowed = sum(rl.allows("peer-a") for _ in range(10))
+    assert allowed == 5
+
+
+def test_network_heartbeat_wires_the_pruning():
+    """Integration: Network.heartbeat() actually calls maintain() and
+    rate_limiter.prune() (the satellite wiring, not just the units)."""
+
+    async def go():
+        swarm = Swarm()
+        try:
+            a = swarm.add_node()
+            b = swarm.add_node()
+            await swarm.connect(a, b)
+            # burn limiter state on a's server from b's pings
+            for _ in range(3):
+                await b.net.reqresp.request(a.peer_id, PING, 1)
+            assert len(a.net.reqresp.rate_limiter) >= 1
+            # age everything out by shifting the limiter's clock forward
+            rl = a.net.reqresp.rate_limiter
+            rl._now = lambda: time.monotonic() + 3600.0
+            await a.net.heartbeat()
+            assert len(rl) == 0, "heartbeat did not prune the rate limiter"
+        finally:
+            swarm.close()
+
+    run(go())

@@ -22,6 +22,26 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _beacon_deps_missing() -> str:
+    """The spawned beacon processes dial TCP+noise (network/wire.py),
+    which needs the `cryptography` package; on hosts without it both
+    children die at import time and the test can only fail.  Same skip
+    idiom as tests/test_cli_node.py."""
+    import importlib.util
+
+    if importlib.util.find_spec("cryptography") is None:
+        return (
+            "beacon subprocess needs the 'cryptography' package "
+            "(network/wire.py noise sessions); not installed in this env"
+        )
+    return ""
+
+
+pytestmark = pytest.mark.skipif(
+    bool(_beacon_deps_missing()), reason=_beacon_deps_missing() or "deps ok"
+)
+
+
 def _spawn(args, env):
     return subprocess.Popen(
         [sys.executable, "-m", "lodestar_tpu.cli.main", *args],

@@ -8,6 +8,13 @@ import asyncio
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="noise sessions need the 'cryptography' package; the "
+    "insecure-transport conformance suite in tests/test_swarm.py still "
+    "covers the TCP binding on hosts without it",
+)
+
 from lodestar_tpu.network import noise, wire
 from lodestar_tpu.network.wire import WireTransport
 
