@@ -1,0 +1,33 @@
+"""Multi-tenant BLS verification sidecar (docs/BLSPOOL.md).
+
+The paper's literal north star — "a JAX sidecar that runs batched
+pairings on TPU" — served to N beacon nodes behind the IBlsVerifier
+boundary (chain/bls/interface.py).  One process owns the device pool;
+every tenant node plugs a ``RemoteBlsVerifier`` into its ``BeaconChain``
+unchanged and the server coalesces cross-tenant traffic into the same
+AOT bucket rungs no single node's offered load can fill.
+
+* ``server.BlsPoolServer``  — tenancy, GCRA fairness, cross-tenant
+  coalescing, degradation stamping; binds to a MeshFabric protocol or
+  the HTTP endpoint in ``http.py``.
+* ``client.RemoteBlsVerifier`` — the BlsVerifier implementation a
+  tenant runs; degrades to the local host oracle when the sidecar is
+  unreachable (never throws).
+* ``codec``  — the JSON wire schema shared by both bindings.
+* ``python -m lodestar_tpu.blspool serve`` — the second-process entry
+  (``__main__.py``), announced-port idiom of testing/mock_el_server.py.
+"""
+from .client import TIER_LOCAL_HOST, FabricPoolTransport, RemoteBlsVerifier
+from .codec import CodecError
+from .metrics import BlsPoolSidecarMetrics
+from .server import PROTOCOL_ID, BlsPoolServer
+
+__all__ = [
+    "BlsPoolServer",
+    "BlsPoolSidecarMetrics",
+    "CodecError",
+    "FabricPoolTransport",
+    "PROTOCOL_ID",
+    "RemoteBlsVerifier",
+    "TIER_LOCAL_HOST",
+]

@@ -1,0 +1,145 @@
+"""RemoteBlsVerifier: a tenant's end of the BLS sidecar.
+
+Implements the ``BlsVerifier`` Protocol (chain/bls/interface.py) so a
+``BeaconChain`` plugs in unchanged.  The degradation contract is the
+PR 7 ladder extended one hop outward: sidecar unreachable (or
+shedding, or erroring) → bounded retry → the LOCAL host oracle — a
+waiter always gets a boolean verdict, never a transport exception.
+Every hop is stamped: remote verdicts carry the server's
+``degradation_tier``/``breaker_state``; local fallbacks stamp
+``TIER_LOCAL_HOST``, so a tenant quietly living off its own CPU cannot
+masquerade as device throughput (``last_stamp`` + the
+``client_local_fallbacks_total`` counter).
+
+Fault checkpoint (docs/FAULTS.md): ``blspool.rpc.request`` fires per
+send attempt — a Drop loses that attempt (retry, then degrade), a
+Delay stalls it.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Sequence
+
+from lodestar_tpu.chain.bls.interface import VerifyOptions
+from lodestar_tpu.chain.bls.single_thread import SingleThreadBlsVerifier
+from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_set
+from lodestar_tpu.testing import faults
+from lodestar_tpu.utils import get_logger
+from . import codec
+from .metrics import BlsPoolSidecarMetrics
+
+# the tier stamped on verdicts served by the client's own fallback
+# oracle — distinct from the server-side "host" tier so the two
+# degradations (sidecar on host fallback vs sidecar unreachable) are
+# tellable apart in metrics
+TIER_LOCAL_HOST = "local_host"
+
+DEFAULT_ATTEMPTS = 2
+
+
+class FabricPoolTransport:
+    """Sidecar transport over a MeshFabric link (loopback in swarms,
+    TCP+noise in deployment — whatever the fabric is bound to)."""
+
+    def __init__(self, fabric, server_peer_id: str):
+        self._fabric = fabric
+        self._server = server_peer_id
+
+    async def request(self, data: bytes) -> bytes:
+        from .server import PROTOCOL_ID
+
+        return await self._fabric.request(self._server, PROTOCOL_ID, data)
+
+    async def close(self) -> None:
+        return None
+
+
+class RemoteBlsVerifier:
+    """BlsVerifier served by a BlsPoolServer, with local degradation."""
+
+    def __init__(
+        self,
+        transport,
+        *,
+        tenant: str = "default",
+        metrics: Optional[BlsPoolSidecarMetrics] = None,
+        attempts: int = DEFAULT_ATTEMPTS,
+        fallback=None,
+    ):
+        self._transport = transport
+        self._tenant = tenant
+        self._metrics = metrics
+        self._attempts = max(1, attempts)
+        self._fallback = fallback if fallback is not None else SingleThreadBlsVerifier()
+        self._log = get_logger("blspool-client")
+        # the most recent verdict's provenance, for tests and bench
+        # stamping: {"degradation_tier": ..., "breaker_state": ...}
+        self.last_stamp: dict = {}
+        self.local_fallbacks = 0
+
+    async def verify_signature_sets(
+        self, sets: Sequence[SignatureSet], opts: VerifyOptions = VerifyOptions()
+    ) -> bool:
+        if not sets:
+            return False
+        if opts.verify_on_main_thread:
+            # the caller explicitly wants a local synchronous verdict —
+            # never a wire round-trip
+            return all(verify_signature_set(s) for s in sets)
+        payload = codec.encode_request(self._tenant, sets, batchable=opts.batchable)
+        for attempt in range(self._attempts):
+            try:
+                faults.fire(
+                    "blspool.rpc.request", tenant=self._tenant, attempt=attempt
+                )
+            except faults.Delay as d:
+                await asyncio.sleep(d.seconds)
+            except faults.FaultError:
+                # the request frame was lost in flight: next attempt
+                continue
+            try:
+                raw = await self._transport.request(payload)
+                resp = codec.decode_response(raw)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # transport/codec failure — a dead sidecar, a timeout, a
+                # garbled response; all retryable, all degradable
+                self._log.debug(
+                    f"sidecar attempt {attempt + 1}/{self._attempts} "
+                    f"failed: {type(e).__name__}: {e}"
+                )
+                continue
+            if resp.get("ok"):
+                tier = resp.get("degradation_tier") or "unknown"
+                self.last_stamp = {
+                    "degradation_tier": tier,
+                    "breaker_state": resp.get("breaker_state"),
+                    "coalesced_width": resp.get("coalesced_width"),
+                    "coalesced_tenants": resp.get("coalesced_tenants"),
+                }
+                if self._metrics:
+                    self._metrics.client_remote_verdicts_total.labels(
+                        tier=tier
+                    ).inc()
+                return bool(resp.get("valid"))
+            # a served REJECTION (shed, overload, server error): retry
+            # once in case the window clears, then degrade locally
+            self._log.debug(
+                f"sidecar rejected request: {resp.get('error', 'unknown')}"
+            )
+        # bounded retries exhausted: the local host oracle answers.
+        # Device/transport trouble never throws past this point — only a
+        # genuine local verification bug could.
+        self.local_fallbacks += 1
+        self.last_stamp = {
+            "degradation_tier": TIER_LOCAL_HOST,
+            "breaker_state": None,
+        }
+        if self._metrics:
+            self._metrics.client_local_fallbacks_total.inc()
+        return await self._fallback.verify_signature_sets(sets, opts)
+
+    async def close(self) -> None:
+        await self._transport.close()
+        await self._fallback.close()

@@ -1,0 +1,133 @@
+"""BLS sidecar wire codec — one JSON schema, two bindings.
+
+The fabric reqresp binding (server.BlsPoolServer.attach) and the HTTP
+binding (http.BlsPoolHttpServer) carry EXACTLY these bytes; the schema
+is documented in docs/BLSPOOL.md.  Curve points travel in their
+compressed byte encodings (48B G1 pubkey / 96B G2 signature, hex), so
+decoding a request performs the same subgroup/point validation every
+other ingress path performs — a malformed point is a CodecError, never
+a crash deeper in the pool.
+
+Deliberately jax-free and asyncio-free: pure bytes -> values.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence, Tuple
+
+from lodestar_tpu.crypto.bls.api import PublicKey, Signature, SignatureSet
+
+SCHEMA_VERSION = 1
+
+# response error codes (docs/BLSPOOL.md): the client retries/degrades on
+# any of them, but dashboards and tests distinguish the causes
+ERR_RATE_LIMITED = "rate_limited"
+ERR_OVERLOADED = "overloaded"
+ERR_BAD_REQUEST = "bad_request"
+ERR_VERIFY_FAILED = "verify_error"
+ERR_SERVER_CLOSED = "server_closed"
+
+
+class CodecError(ValueError):
+    """Malformed sidecar request/response payload."""
+
+
+def _hex(data: bytes) -> str:
+    return "0x" + data.hex()
+
+
+def _unhex(value, what: str) -> bytes:
+    if not isinstance(value, str):
+        raise CodecError(f"{what}: expected hex string")
+    try:
+        return bytes.fromhex(value.removeprefix("0x"))
+    except ValueError:
+        raise CodecError(f"{what}: not hex") from None
+
+
+def encode_request(
+    tenant: str, sets: Sequence[SignatureSet], batchable: bool = True
+) -> bytes:
+    body = {
+        "v": SCHEMA_VERSION,
+        "tenant": tenant,
+        "batchable": bool(batchable),
+        "sets": [
+            {
+                "pubkey": _hex(s.public_key.to_bytes()),
+                "message": _hex(s.message),
+                "signature": _hex(s.signature.to_bytes()),
+            }
+            for s in sets
+        ],
+    }
+    return json.dumps(body, separators=(",", ":")).encode()
+
+
+def decode_request(data: bytes) -> Tuple[Optional[str], List[SignatureSet], bool]:
+    """-> (tenant or None, sets, batchable).  Raises CodecError on any
+    malformation, including invalid curve points."""
+    try:
+        body = json.loads(data.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CodecError(f"request is not JSON: {e}") from None
+    if not isinstance(body, dict) or body.get("v") != SCHEMA_VERSION:
+        raise CodecError("unknown request schema version")
+    tenant = body.get("tenant")
+    if tenant is not None and not isinstance(tenant, str):
+        raise CodecError("tenant: expected string")
+    raw_sets = body.get("sets")
+    if not isinstance(raw_sets, list):
+        raise CodecError("sets: expected list")
+    sets: List[SignatureSet] = []
+    for i, raw in enumerate(raw_sets):
+        if not isinstance(raw, dict):
+            raise CodecError(f"sets[{i}]: expected object")
+        try:
+            pk = PublicKey.from_bytes(_unhex(raw.get("pubkey"), f"sets[{i}].pubkey"))
+            sig = Signature.from_bytes(
+                _unhex(raw.get("signature"), f"sets[{i}].signature")
+            )
+        except CodecError:
+            raise
+        except ValueError as e:
+            raise CodecError(f"sets[{i}]: invalid point encoding: {e}") from None
+        msg = _unhex(raw.get("message"), f"sets[{i}].message")
+        sets.append(SignatureSet(public_key=pk, message=msg, signature=sig))
+    return tenant, sets, bool(body.get("batchable", True))
+
+
+def encode_response(
+    *,
+    ok: bool,
+    valid: bool = False,
+    error: Optional[str] = None,
+    degradation_tier: Optional[str] = None,
+    breaker_state: Optional[str] = None,
+    coalesced_width: int = 0,
+    coalesced_tenants: int = 0,
+) -> bytes:
+    body = {
+        "v": SCHEMA_VERSION,
+        "ok": bool(ok),
+        "valid": bool(valid),
+        "degradation_tier": degradation_tier,
+        "breaker_state": breaker_state,
+        "coalesced_width": int(coalesced_width),
+        "coalesced_tenants": int(coalesced_tenants),
+    }
+    if error is not None:
+        body["error"] = error
+    return json.dumps(body, separators=(",", ":")).encode()
+
+
+def decode_response(data: bytes) -> dict:
+    try:
+        body = json.loads(data.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CodecError(f"response is not JSON: {e}") from None
+    if not isinstance(body, dict) or body.get("v") != SCHEMA_VERSION:
+        raise CodecError("unknown response schema version")
+    if not isinstance(body.get("ok"), bool):
+        raise CodecError("response missing ok verdict")
+    return body

@@ -1,0 +1,102 @@
+"""HTTP+JSON binding for the BLS sidecar (the second-process path).
+
+Mirrors ``testing/mock_el_server.py``: one aiohttp endpoint, ephemeral
+port, announced on stdout by ``__main__.py``.  The payload bytes are
+EXACTLY the fabric binding's (``codec.py``) — the HTTP layer adds only
+framing, so a tenant can switch bindings without touching the schema.
+
+POST /verify   — request body in, response body out (always HTTP 200
+                 for a served response, including sheds: the verdict
+                 lives in the JSON ``ok``/``error`` fields; a raw HTTP
+                 5xx means the server itself failed, which the client
+                 treats as a transport fault)
+GET  /healthz  — liveness probe for process supervisors/tests
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .server import BlsPoolServer
+
+
+class BlsPoolHttpServer:
+    def __init__(self, server: BlsPoolServer):
+        self.server = server
+        self._runner = None
+        self.url: Optional[str] = None
+        self.port: Optional[int] = None
+
+    def build_app(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/verify", self._verify)
+        app.router.add_get("/healthz", self._healthz)
+        return app
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        from aiohttp import web
+
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://{host}:{self.port}"
+        return self.url
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        await self.server.close()
+
+    async def _verify(self, request):
+        from aiohttp import web
+
+        data = await request.read()
+        # transport-level tenant identity: the peer address (the JSON
+        # body's explicit tenant field, when present, wins — see
+        # docs/BLSPOOL.md on the cooperative tenancy model).  An armed
+        # blspool.rpc.respond fault escapes here → aiohttp answers a
+        # bare HTTP 500, the crashing-server shape the client's ladder
+        # must absorb.
+        tenant = request.remote or "http"
+        body = await self.server.handle_payload(tenant, data)
+        return web.Response(body=body, content_type="application/json")
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.json_response({"ok": True})
+
+
+class HttpPoolTransport:
+    """Client-side transport for RemoteBlsVerifier over the HTTP
+    binding (``lodestar-tpu beacon --bls-pool-url``)."""
+
+    def __init__(self, url: str, request_timeout: float = 10.0):
+        self._url = url.rstrip("/")
+        self._timeout = request_timeout
+        self._session = None
+
+    async def request(self, data: bytes) -> bytes:
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._timeout)
+            )
+        async with self._session.post(
+            self._url + "/verify",
+            data=data,
+            headers={"Content-Type": "application/json"},
+        ) as resp:
+            if resp.status != 200:
+                raise ConnectionError(f"sidecar HTTP {resp.status}")
+            return await resp.read()
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        self._session = None

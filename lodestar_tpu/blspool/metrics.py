@@ -1,0 +1,87 @@
+"""Prometheus metrics for the BLS sidecar (ROADMAP 9b slice).
+
+Per-tenant accounting is the point: the sidecar's economics rest on
+cross-tenant coalescing, and its fairness promise rests on per-tenant
+GCRA shedding — both must be visible on a dashboard
+(dashboards/lodestar_tpu_blspool.json), not inferred from logs.  The
+``lodestar_tpu_blspool`` namespace is distinct from the in-process
+pool's ``lodestar_tpu_bls_pool`` family: one sidecar process serves
+many nodes, so its series would double-count if they shared a family.
+"""
+from __future__ import annotations
+
+from prometheus_client import Counter, Gauge, Histogram, REGISTRY
+
+
+class BlsPoolSidecarMetrics:
+    _instance = None
+
+    def __init__(self, registry=REGISTRY):
+        ns = "lodestar_tpu_blspool"
+        self.requests_total = Counter(
+            f"{ns}_requests_total",
+            "Verification requests received, by tenant",
+            labelnames=("tenant",),
+            registry=registry,
+        )
+        self.sets_total = Counter(
+            f"{ns}_sets_total",
+            "Signature sets offered, by tenant (admitted or shed)",
+            labelnames=("tenant",),
+            registry=registry,
+        )
+        self.shed_total = Counter(
+            f"{ns}_shed_total",
+            "Requests shed by per-tenant GCRA admission or pool "
+            "backpressure, by tenant",
+            labelnames=("tenant",),
+            registry=registry,
+        )
+        self.batches_total = Counter(
+            f"{ns}_batches_total",
+            "Cross-tenant coalesced batches dispatched to the inner pool",
+            registry=registry,
+        )
+        self.batch_width = Histogram(
+            f"{ns}_batch_width",
+            "Coalesced batch width (signature sets per dispatched batch)",
+            buckets=(1, 4, 16, 64, 128, 256, 512, 1024, 2048),
+            registry=registry,
+        )
+        self.batch_tenants = Histogram(
+            f"{ns}_batch_tenants",
+            "Distinct tenants per coalesced batch",
+            buckets=(1, 2, 4, 8, 16, 32),
+            registry=registry,
+        )
+        self.responses_total = Counter(
+            f"{ns}_responses_total",
+            "Served verdicts by degradation tier (device vs host "
+            "fallback — a tenant-visible stamp, docs/BLSPOOL.md)",
+            labelnames=("tier",),
+            registry=registry,
+        )
+        self.pending_sets = Gauge(
+            f"{ns}_pending_sets",
+            "Signature sets admitted and awaiting a coalesced batch",
+            registry=registry,
+        )
+        self.client_local_fallbacks_total = Counter(
+            f"{ns}_client_local_fallbacks_total",
+            "Client-side degradations to the local host oracle "
+            "(sidecar unreachable, shedding, or erroring)",
+            registry=registry,
+        )
+        self.client_remote_verdicts_total = Counter(
+            f"{ns}_client_remote_verdicts_total",
+            "Verdicts this tenant received from the sidecar, by the "
+            "tier the server stamped",
+            labelnames=("tier",),
+            registry=registry,
+        )
+
+    @classmethod
+    def get(cls) -> "BlsPoolSidecarMetrics":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance

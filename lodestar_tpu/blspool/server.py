@@ -1,0 +1,321 @@
+"""BLS sidecar server: tenancy, fairness, cross-tenant coalescing.
+
+One ``BlsPoolServer`` fronts one inner ``BlsVerifier`` — the device
+pool (``DeviceBlsVerifier``) where an accelerator exists, the host
+oracle otherwise — and serves N tenant nodes.  The multi-tenant
+intelligence lives HERE, not in the inner pool:
+
+* **admission** — per-tenant GCRA (``reqresp/rate_limiter.py``) with
+  request weight = signature-set count, so one tenant's flood is shed
+  at the door while light tenants keep their full quota, plus a
+  pool-wide pending-sets bound (backpressure) so an admitted backlog
+  can never grow without limit;
+* **coalescing** — admitted requests buffer for a short window and
+  dispatch as ONE batch across tenants.  Width quantization stays the
+  inner pool's job (``buckets.pool_bucket`` — the coalescer can only
+  ever produce widths the AOT warm registry knows because the only
+  dispatch path is ``DeviceBlsVerifier.verify_signature_sets``); the
+  coalescer's contribution is filling rungs no single tenant's offered
+  load can fill.  A ``False`` batch verdict re-verifies per REQUEST so
+  one tenant's invalid set cannot poison another tenant's verdict;
+* **degradation stamping** — every response carries
+  ``degradation_tier``/``breaker_state`` read from the inner pool's
+  circuit breaker, so a tenant can tell device verdicts from host
+  fallbacks (the PR 7 contract, extended across the wire).
+
+Fault checkpoints (docs/FAULTS.md): ``blspool.rpc.respond`` at request
+ingress (Delay stalls the response, any other FaultError makes the
+binding surface a transport-level error — the shape of a crashing
+server) and ``blspool.batch.coalesce`` at batch formation (a fault
+fails the batch servably: every waiter gets an error RESPONSE and the
+client-side ladder takes over).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Set, Tuple
+
+from lodestar_tpu.chain.bls import breaker as brk
+from lodestar_tpu.chain.bls.device_pool import MAX_SIGNATURE_SETS_PER_JOB
+from lodestar_tpu.chain.bls.interface import VerifyOptions
+from lodestar_tpu.chain.bls.single_thread import SingleThreadBlsVerifier
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.network.reqresp.rate_limiter import RateLimiterGCRA
+from lodestar_tpu.testing import faults
+from lodestar_tpu.utils import gather_settled, get_logger
+from . import codec
+from .metrics import BlsPoolSidecarMetrics
+
+PROTOCOL_ID = "/lodestar_tpu/blspool/verify/1"
+
+# Per-tenant admission: sets per window.  A single tenant at the
+# steady-state gossip firehose offers ~500 sets/s; the default leaves
+# each tenant that much headroom while a flood (weight > the whole
+# burst window) is rejected outright — without ever mutating the
+# tenant's TAT, so a shed flood cannot poison its OWN future quota
+# (pinned by tests/test_blspool.py::TestGcraWeightSemantics).
+DEFAULT_TENANT_QUOTA = (2048, 2_000)
+
+# Coalescing window: long enough to collect concurrent tenants' bursts
+# into one rung, short next to the inner pool's own 100 ms batching
+# window (the two windows pipeline, they do not add for steady flow).
+COALESCE_WAIT_MS = 10
+
+
+@dataclass
+class _PendingRequest:
+    tenant: str
+    sets: List[SignatureSet]
+    future: "asyncio.Future[dict]"  # resolves to response-body kwargs
+
+
+class BlsPoolServer:
+    """Transport-agnostic sidecar core: both bindings (fabric reqresp,
+    HTTP) feed ``handle_payload`` and return its bytes verbatim."""
+
+    def __init__(
+        self,
+        verifier=None,
+        *,
+        metrics: Optional[BlsPoolSidecarMetrics] = None,
+        tenant_quota: Tuple[int, int] = DEFAULT_TENANT_QUOTA,
+        coalesce_wait_ms: float = COALESCE_WAIT_MS,
+        max_sets_per_batch: int = MAX_SIGNATURE_SETS_PER_JOB,
+        max_pending_sets: Optional[int] = None,
+        now=time.monotonic,
+    ):
+        self._verifier = verifier if verifier is not None else SingleThreadBlsVerifier()
+        self._limiter = RateLimiterGCRA(tenant_quota[0], tenant_quota[1], now=now)
+        self._metrics = metrics
+        self._coalesce_wait_s = coalesce_wait_ms / 1000
+        self._max_sets_per_batch = max_sets_per_batch
+        # backpressure bound: two full batches of admitted-but-unserved
+        # sets is overload — shedding is cheaper than unbounded latency
+        self._max_pending_sets = (
+            max_pending_sets if max_pending_sets is not None
+            else 2 * max_sets_per_batch
+        )
+        self._pending: List[_PendingRequest] = []
+        self._pending_sets = 0
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._closed = False
+        self._tasks: Set[asyncio.Task] = set()
+        self._log = get_logger("blspool")
+        # per-batch (width, distinct-tenant count) — the swarm proof
+        # asserts on this (and the width histogram mirrors it)
+        self.batch_log: List[Tuple[int, int]] = []
+        self.shed_log: List[str] = []  # tenant per shed, in order
+
+    # -- bindings ------------------------------------------------------
+
+    def attach(self, fabric) -> None:
+        """Serve over a MeshFabric (loopback for swarms, TCP+noise for
+        deployment — the transport conformance suite covers both)."""
+        fabric.handle(PROTOCOL_ID, self._handle_rpc)
+
+    async def _handle_rpc(self, from_peer: str, proto: str, data: bytes) -> bytes:
+        return await self.handle_payload(from_peer, data)
+
+    # -- request path --------------------------------------------------
+
+    async def handle_payload(self, default_tenant: str, data: bytes) -> bytes:
+        """One request's bytes in, one response's bytes out.  Raises
+        only for an injected ``blspool.rpc.respond`` fault (the binding
+        turns that into its transport-level error shape)."""
+        try:
+            tenant, sets, _batchable = codec.decode_request(data)
+        except codec.CodecError as e:
+            return codec.encode_response(
+                ok=False, error=f"{codec.ERR_BAD_REQUEST}: {e}"
+            )
+        tenant = tenant or default_tenant
+        try:
+            faults.fire("blspool.rpc.respond", tenant=tenant, sets=len(sets))
+        except faults.Delay as d:
+            await asyncio.sleep(d.seconds)
+        if self._metrics:
+            self._metrics.requests_total.labels(tenant=tenant).inc()
+            if sets:
+                self._metrics.sets_total.labels(tenant=tenant).inc(len(sets))
+        if self._closed:
+            return codec.encode_response(ok=False, error=codec.ERR_SERVER_CLOSED)
+        if not sets:
+            # the BlsVerifier contract: empty input is a False verdict
+            tier, state = self._stamp()
+            return codec.encode_response(
+                ok=True, valid=False, degradation_tier=tier, breaker_state=state
+            )
+
+        # admission: GCRA fairness (weight = set count) then backpressure
+        if not self._limiter.allows(tenant, weight=len(sets)):
+            return self._shed(tenant, codec.ERR_RATE_LIMITED)
+        if self._pending_sets + len(sets) > self._max_pending_sets:
+            return self._shed(tenant, codec.ERR_OVERLOADED)
+
+        req = _PendingRequest(
+            tenant=tenant,
+            sets=sets,
+            future=asyncio.get_running_loop().create_future(),
+        )
+        self._pending.append(req)
+        self._pending_sets += len(sets)
+        if self._metrics:
+            self._metrics.pending_sets.set(self._pending_sets)
+        if self._pending_sets >= self._max_sets_per_batch:
+            self._schedule_flush(0)
+        elif self._flush_handle is None:
+            self._schedule_flush(self._coalesce_wait_s)
+        body = await req.future
+        return codec.encode_response(**body)
+
+    def _shed(self, tenant: str, error: str) -> bytes:
+        self.shed_log.append(tenant)
+        if self._metrics:
+            self._metrics.shed_total.labels(tenant=tenant).inc()
+        return codec.encode_response(ok=False, error=error)
+
+    # -- coalescing ----------------------------------------------------
+
+    def _schedule_flush(self, delay: float) -> None:
+        loop = asyncio.get_running_loop()
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+        self._flush_handle = loop.call_later(delay, self._flush)
+
+    def _flush(self) -> None:
+        """Work-conserving: take the whole backlog (up to the batch
+        cap) as ONE cross-tenant batch; anything left re-arms."""
+        self._flush_handle = None
+        if self._closed or not self._pending:
+            return
+        batch: List[_PendingRequest] = []
+        count = 0
+        while self._pending:
+            req = self._pending[0]
+            if batch and count + len(req.sets) > self._max_sets_per_batch:
+                break
+            batch.append(self._pending.pop(0))
+            count += len(req.sets)
+        self._pending_sets -= count
+        if self._metrics:
+            self._metrics.pending_sets.set(self._pending_sets)
+        task = asyncio.ensure_future(self._run_batch(batch))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        if self._pending:
+            self._schedule_flush(self._coalesce_wait_s)
+
+    async def _run_batch(self, batch: List[_PendingRequest]) -> None:
+        all_sets: List[SignatureSet] = []
+        tenants: Set[str] = set()
+        for req in batch:
+            all_sets.extend(req.sets)
+            tenants.add(req.tenant)
+        width, n_tenants = len(all_sets), len(tenants)
+        try:
+            faults.fire("blspool.batch.coalesce", width=width, tenants=n_tenants)
+        except faults.Delay as d:
+            await asyncio.sleep(d.seconds)
+        except faults.FaultError as e:
+            # chaos: the batch fails SERVABLY — error responses, never
+            # stranded waiters (the client ladder retries or degrades)
+            self._fail_batch(batch, f"{codec.ERR_VERIFY_FAILED}: {e}")
+            return
+        self.batch_log.append((width, n_tenants))
+        if self._metrics:
+            self._metrics.batches_total.inc()
+            self._metrics.batch_width.observe(width)
+            self._metrics.batch_tenants.observe(n_tenants)
+        try:
+            verdict = await self._verifier.verify_signature_sets(
+                all_sets, VerifyOptions(batchable=True)
+            )
+            per_req: List[bool]
+            if verdict:
+                per_req = [True] * len(batch)
+            else:
+                # per-REQUEST split: tenant isolation for verdicts too —
+                # re-verification rides the inner pool's own batch path
+                per_req = await gather_settled(
+                    *(
+                        self._verifier.verify_signature_sets(
+                            req.sets, VerifyOptions(batchable=True)
+                        )
+                        for req in batch
+                    )
+                )
+        except asyncio.CancelledError:
+            self._fail_batch(batch, codec.ERR_SERVER_CLOSED)
+            raise
+        except Exception as e:
+            self._log.warn(
+                f"inner verifier failed a coalesced batch "
+                f"(width={width}): {type(e).__name__}: {e}"
+            )
+            self._fail_batch(batch, f"{codec.ERR_VERIFY_FAILED}: {type(e).__name__}")
+            return
+        tier, state = self._stamp()
+        if self._metrics:
+            self._metrics.responses_total.labels(tier=tier).inc(len(batch))
+        for req, ok in zip(batch, per_req):
+            if not req.future.done():
+                req.future.set_result(
+                    dict(
+                        ok=True,
+                        valid=bool(ok),
+                        degradation_tier=tier,
+                        breaker_state=state,
+                        coalesced_width=width,
+                        coalesced_tenants=n_tenants,
+                    )
+                )
+
+    def _fail_batch(self, batch: List[_PendingRequest], error: str) -> None:
+        for req in batch:
+            if not req.future.done():
+                req.future.set_result(dict(ok=False, error=error))
+
+    # -- degradation stamp ---------------------------------------------
+
+    def _stamp(self) -> Tuple[str, str]:
+        """(degradation_tier, breaker_state) for a response.  Read from
+        the inner pool's breaker: ``device`` only while the breaker is
+        closed (verdicts ride the device), ``host`` otherwise — and
+        ALWAYS ``host`` for a breaker-less oracle, so a sidecar without
+        a device can never masquerade as device throughput."""
+        breaker = getattr(self._verifier, "_breaker", None)
+        if breaker is None:
+            return brk.TIER_HOST, brk.CLOSED
+        state = breaker.state
+        tier = brk.TIER_DEVICE if state == brk.CLOSED else brk.TIER_HOST
+        return tier, state
+
+    # -- lifecycle -----------------------------------------------------
+
+    def prune(self, older_than_ms: float = 60_000) -> None:
+        """Drop idle tenants' TAT state (the reqresp heartbeat idiom)."""
+        self._limiter.prune(older_than_ms)
+
+    async def close(self) -> None:
+        """Cancel-and-settle: pending requests get error RESPONSES (the
+        client degrades locally), in-flight batch tasks are awaited, and
+        the inner verifier is shut down."""
+        self._closed = True
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        for req in self._pending:
+            if not req.future.done():
+                req.future.set_result(
+                    dict(ok=False, error=codec.ERR_SERVER_CLOSED)
+                )
+        self._pending.clear()
+        self._pending_sets = 0
+        tasks = [t for t in self._tasks if not t.done()]
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        await self._verifier.close()

@@ -64,6 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
     beacon.add_argument(
         "--verifier", choices=["auto", "oracle", "device"], default="auto"
     )
+    beacon.add_argument(
+        "--bls-pool-url", type=str, default=None,
+        help="BLS sidecar endpoint (python -m lodestar_tpu.blspool "
+             "serve); the node verifies through the shared pool via "
+             "RemoteBlsVerifier and degrades to its local host oracle "
+             "if the sidecar is unreachable — overrides --verifier",
+    )
     beacon.add_argument("--slots", type=int, default=None,
                         help="exit after N clock slots (default: run forever)")
     # live execution-layer seam (execution/engine.py + eth1/http_provider.py):
@@ -438,7 +445,19 @@ def run_beacon(args) -> int:
         _, anchor = init_dev_state(cfg, args.validators, genesis_time=genesis_time)
 
     verifier = None
-    if resolve_verifier_choice(args.verifier) == "device":
+    if getattr(args, "bls_pool_url", None):
+        # shared-pool tenancy (docs/BLSPOOL.md): verification rides the
+        # sidecar; the RemoteBlsVerifier's own ladder falls back to the
+        # local host oracle if the sidecar goes away
+        from lodestar_tpu.blspool import RemoteBlsVerifier
+        from lodestar_tpu.blspool.http import HttpPoolTransport
+
+        verifier = RemoteBlsVerifier(
+            HttpPoolTransport(args.bls_pool_url),
+            tenant=f"beacon-{os.getpid()}",
+        )
+        print(f"bls verification: sidecar {args.bls_pool_url}", flush=True)
+    elif resolve_verifier_choice(args.verifier) == "device":
         from lodestar_tpu.chain.bls import DeviceBlsVerifier
 
         verifier = DeviceBlsVerifier()

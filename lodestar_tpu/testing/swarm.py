@@ -169,6 +169,44 @@ class Swarm:
         a.net.peer_manager.on_disconnect(b.peer_id)
         b.net.peer_manager.on_disconnect(a.peer_id)
 
+    async def attach_blspool(
+        self,
+        verifier=None,
+        metrics=None,
+        request_timeout: float = 1.0,
+        **server_kwargs,
+    ):
+        """Attach ONE shared BLS sidecar to the swarm (ISSUE 16): a
+        dedicated fabric endpoint on the loopback running a
+        ``BlsPoolServer`` over ``verifier`` (default: host oracle), plus
+        a ``RemoteBlsVerifier`` per node — stored as ``node.bls_client``
+        AND installed as the chain's verifier, so block import verifies
+        through the pool.  The caller owns the server's shutdown
+        (``await swarm.blspool_server.close()`` before ``close()``)."""
+        from lodestar_tpu.blspool import (
+            BlsPoolServer,
+            FabricPoolTransport,
+            RemoteBlsVerifier,
+        )
+
+        fabric = self.loopback.register(
+            MeshFabric("blspool", request_timeout=request_timeout)
+        )
+        server = BlsPoolServer(verifier, metrics=metrics, **server_kwargs)
+        server.attach(fabric)
+        for node in self.nodes:
+            await self.loopback.connect(node.fabric, fabric)
+            client = RemoteBlsVerifier(
+                FabricPoolTransport(node.fabric, fabric.peer_id),
+                tenant=node.peer_id,
+                metrics=metrics,
+            )
+            node.bls_client = client
+            node.chain.bls = client
+        self.blspool_server = server
+        self.blspool_fabric = fabric
+        return server
+
     def close(self) -> None:
         for node in self.nodes:
             node.net.close()

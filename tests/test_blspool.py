@@ -1,0 +1,608 @@
+"""BLS sidecar unit tests: wire codec, cross-tenant coalescing, GCRA
+fairness + backpressure shedding, degradation stamping, chaos on the
+``blspool.*`` checkpoints, client retry/degrade ladder, AOT hygiene.
+
+Uses fast structural fake inner verifiers (no real pairings — the
+crypto itself is covered by tests/test_bls_conformance_vectors.py and
+the pool service by tests/test_bls_verifier_service.py); wire payloads
+still need REAL curve points because the codec validates them, so one
+real signed set is minted per weight and reused.
+"""
+import asyncio
+import json
+
+import pytest
+
+from lodestar_tpu.blspool import (
+    TIER_LOCAL_HOST,
+    BlsPoolServer,
+    CodecError,
+    RemoteBlsVerifier,
+)
+from lodestar_tpu.blspool import codec
+from lodestar_tpu.chain.bls import breaker as brk
+from lodestar_tpu.chain.bls.breaker import DeviceCircuitBreaker
+from lodestar_tpu.chain.bls.interface import VerifyOptions
+from lodestar_tpu.crypto.bls.api import SecretKey, SignatureSet
+from lodestar_tpu.network.reqresp.rate_limiter import RateLimiterGCRA
+from lodestar_tpu.utils import gather_settled
+from lodestar_tpu.testing import faults
+
+pytestmark = pytest.mark.fast
+
+BAD_MSG = b"\xee" * 32  # marker: fake verifiers treat this set as invalid
+
+_SET_CACHE = {}
+
+
+def make_sets(n, valid=True):
+    """Real curve points (the codec validates them) but each (i, valid)
+    signature is minted once per process — signing is the expensive
+    part and these tests never re-verify for real."""
+    out = []
+    for i in range(n):
+        key = (i, valid)
+        if key not in _SET_CACHE:
+            sk = SecretKey.from_bytes(bytes([0] * 30 + [3, i + 1]))
+            msg = bytes([i ^ 0x5A]) * 32 if valid else BAD_MSG
+            _SET_CACHE[key] = SignatureSet(sk.to_public_key(), msg, sk.sign(msg))
+        out.append(_SET_CACHE[key])
+    return out
+
+
+class FakeInnerVerifier:
+    """Structural BlsVerifier: a set is 'valid' iff its message is not
+    the BAD_MSG marker.  Records every dispatch width."""
+
+    def __init__(self, breaker=None):
+        self.calls = []
+        self.closed = False
+        if breaker is not None:
+            self._breaker = breaker
+
+    async def verify_signature_sets(self, sets, opts=VerifyOptions()):
+        self.calls.append(len(sets))
+        return bool(sets) and all(s.message != BAD_MSG for s in sets)
+
+    async def close(self):
+        self.closed = True
+
+
+class DirectTransport:
+    """Client transport that feeds the server core in-process — the
+    binding-free path, so these tests exercise sidecar logic without a
+    fabric in the loop (tests/test_blspool_swarm.py covers the fabric)."""
+
+    def __init__(self, server, tenant="direct"):
+        self._server = server
+        self._tenant = tenant
+        self.closed = False
+
+    async def request(self, data: bytes) -> bytes:
+        return await self._server.handle_payload(self._tenant, data)
+
+    async def close(self):
+        self.closed = True
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+def _request(tenant, n_sets=1, valid=True):
+    return codec.encode_request(tenant, make_sets(n_sets, valid=valid))
+
+
+class TestCodec:
+    def test_request_roundtrip_preserves_points_and_tenant(self):
+        sets = make_sets(2)
+        data = codec.encode_request("node-a", sets, batchable=False)
+        tenant, decoded, batchable = codec.decode_request(data)
+        assert tenant == "node-a"
+        assert batchable is False
+        assert len(decoded) == 2
+        for a, b in zip(sets, decoded):
+            assert a.public_key.to_bytes() == b.public_key.to_bytes()
+            assert a.message == b.message
+            assert a.signature.to_bytes() == b.signature.to_bytes()
+
+    def test_request_without_tenant_decodes_none(self):
+        data = json.dumps({"v": 1, "sets": []}).encode()
+        tenant, sets, batchable = codec.decode_request(data)
+        assert tenant is None and sets == [] and batchable is True
+
+    @pytest.mark.parametrize(
+        "payload",
+        [
+            b"\xff\xfenot json",
+            b"[]",
+            json.dumps({"v": 99, "sets": []}).encode(),
+            json.dumps({"v": 1, "sets": {}}).encode(),
+            json.dumps({"v": 1, "tenant": 7, "sets": []}).encode(),
+            json.dumps(
+                {"v": 1, "sets": [{"pubkey": "zz", "message": "0x", "signature": "0x"}]}
+            ).encode(),
+            # right lengths, garbage bytes: point validation must reject
+            json.dumps(
+                {
+                    "v": 1,
+                    "sets": [
+                        {
+                            "pubkey": "0x" + "11" * 48,
+                            "message": "0x" + "00" * 32,
+                            "signature": "0x" + "22" * 96,
+                        }
+                    ],
+                }
+            ).encode(),
+        ],
+        ids=[
+            "not-json",
+            "not-object",
+            "bad-version",
+            "sets-not-list",
+            "tenant-not-string",
+            "not-hex",
+            "garbage-points",
+        ],
+    )
+    def test_malformed_request_raises_codec_error(self, payload):
+        with pytest.raises(CodecError):
+            codec.decode_request(payload)
+
+    def test_response_roundtrip_carries_stamp(self):
+        data = codec.encode_response(
+            ok=True,
+            valid=True,
+            degradation_tier="device",
+            breaker_state="closed",
+            coalesced_width=640,
+            coalesced_tenants=5,
+        )
+        resp = codec.decode_response(data)
+        assert resp["ok"] is True and resp["valid"] is True
+        assert resp["degradation_tier"] == "device"
+        assert resp["breaker_state"] == "closed"
+        assert resp["coalesced_width"] == 640
+        assert resp["coalesced_tenants"] == 5
+
+    def test_response_missing_ok_raises(self):
+        with pytest.raises(CodecError):
+            codec.decode_response(json.dumps({"v": 1, "valid": True}).encode())
+
+
+class TestServerCoalescing:
+    def test_concurrent_tenants_coalesce_into_one_batch(self):
+        inner = FakeInnerVerifier()
+        server = BlsPoolServer(inner, coalesce_wait_ms=20)
+
+        async def go():
+            try:
+                return await gather_settled(
+                    *(
+                        server.handle_payload(t, _request(t))
+                        for t in ("node-a", "node-b", "node-c")
+                    )
+                )
+            finally:
+                await server.close()
+
+        responses = [codec.decode_response(r) for r in run(go())]
+        assert all(r["ok"] and r["valid"] for r in responses)
+        # ONE cross-tenant dispatch, wider than any single tenant's load
+        assert server.batch_log == [(3, 3)]
+        assert inner.calls == [3]
+        assert all(r["coalesced_width"] == 3 for r in responses)
+        assert all(r["coalesced_tenants"] == 3 for r in responses)
+
+    def test_false_batch_verdict_splits_per_request(self):
+        """One tenant's invalid set cannot poison another tenant's
+        verdict: the coalesced False re-verifies per REQUEST."""
+        inner = FakeInnerVerifier()
+        server = BlsPoolServer(inner, coalesce_wait_ms=20)
+
+        async def go():
+            try:
+                return await gather_settled(
+                    server.handle_payload("good", _request("good")),
+                    server.handle_payload("evil", _request("evil", valid=False)),
+                )
+            finally:
+                await server.close()
+
+        good, evil = [codec.decode_response(r) for r in run(go())]
+        assert good["ok"] and good["valid"] is True
+        assert evil["ok"] and evil["valid"] is False
+        # one coalesced dispatch (False) + one re-verify per request
+        assert inner.calls[0] == 2 and sorted(inner.calls[1:]) == [1, 1]
+
+    def test_full_batch_flushes_without_waiting(self):
+        inner = FakeInnerVerifier()
+        server = BlsPoolServer(
+            inner, coalesce_wait_ms=10_000, max_sets_per_batch=2
+        )
+
+        async def go():
+            try:
+                return await asyncio.wait_for(
+                    gather_settled(
+                        server.handle_payload("a", _request("a")),
+                        server.handle_payload("b", _request("b")),
+                    ),
+                    timeout=2.0,
+                )
+            finally:
+                await server.close()
+
+        responses = [codec.decode_response(r) for r in run(go())]
+        # a 10 s window can't have elapsed inside the 2 s wait_for: the
+        # batch-full path flushed immediately
+        assert all(r["ok"] and r["valid"] for r in responses)
+        assert server.batch_log == [(2, 2)]
+
+    def test_empty_sets_is_false_verdict_not_error(self):
+        server = BlsPoolServer(FakeInnerVerifier())
+
+        async def go():
+            try:
+                return await server.handle_payload(
+                    "t", codec.encode_request("t", [])
+                )
+            finally:
+                await server.close()
+
+        resp = codec.decode_response(run(go()))
+        assert resp["ok"] is True and resp["valid"] is False
+
+    def test_malformed_payload_gets_bad_request_response(self):
+        server = BlsPoolServer(FakeInnerVerifier())
+
+        async def go():
+            try:
+                return await server.handle_payload("t", b"garbage")
+            finally:
+                await server.close()
+
+        resp = codec.decode_response(run(go()))
+        assert resp["ok"] is False
+        assert resp["error"].startswith(codec.ERR_BAD_REQUEST)
+
+
+class TestServerFairness:
+    def test_flood_weight_is_shed_rate_limited(self):
+        inner = FakeInnerVerifier()
+        server = BlsPoolServer(inner, tenant_quota=(4, 60_000))
+
+        async def go():
+            try:
+                flood = await server.handle_payload(
+                    "flooder", _request("flooder", n_sets=5)
+                )
+                light = await server.handle_payload("victim", _request("victim"))
+                return flood, light
+            finally:
+                await server.close()
+
+        flood, light = [codec.decode_response(r) for r in run(go())]
+        assert flood["ok"] is False
+        assert flood["error"] == codec.ERR_RATE_LIMITED
+        # fairness is per tenant: the victim's quota is untouched
+        assert light["ok"] is True and light["valid"] is True
+        assert server.shed_log == ["flooder"]
+
+    def test_backpressure_sheds_overloaded(self):
+        inner = FakeInnerVerifier()
+        server = BlsPoolServer(
+            inner, coalesce_wait_ms=10_000, max_pending_sets=2
+        )
+
+        async def go():
+            try:
+                first = asyncio.ensure_future(
+                    server.handle_payload("a", _request("a", n_sets=2))
+                )
+                await asyncio.sleep(0)  # let it enter the pending buffer
+                second = await server.handle_payload("b", _request("b"))
+                return second, first
+            finally:
+                await server.close()
+
+        async def outer():
+            second, first = await go()
+            return codec.decode_response(second), codec.decode_response(await first)
+
+        second, first = run(outer())
+        assert second["ok"] is False
+        assert second["error"] == codec.ERR_OVERLOADED
+        assert server.shed_log == ["b"]
+        # close() settled the buffered request servably, never stranded
+        assert first["ok"] is False
+        assert first["error"] == codec.ERR_SERVER_CLOSED
+
+
+class TestDegradationStamp:
+    def test_breakerless_oracle_stamps_host(self):
+        server = BlsPoolServer(FakeInnerVerifier())
+
+        async def go():
+            try:
+                return await server.handle_payload("t", _request("t"))
+            finally:
+                await server.close()
+
+        resp = codec.decode_response(run(go()))
+        assert resp["degradation_tier"] == brk.TIER_HOST
+        assert resp["breaker_state"] == brk.CLOSED
+
+    def test_breaker_state_drives_tier(self):
+        breaker = DeviceCircuitBreaker(failure_threshold=3)
+        inner = FakeInnerVerifier(breaker=breaker)
+        server = BlsPoolServer(inner)
+
+        async def one():
+            return codec.decode_response(
+                await server.handle_payload("t", _request("t"))
+            )
+
+        async def go():
+            try:
+                closed = await one()
+                for _ in range(3):
+                    breaker.record_failure()
+                tripped = await one()
+                return closed, tripped
+            finally:
+                await server.close()
+
+        closed, tripped = run(go())
+        assert closed["degradation_tier"] == brk.TIER_DEVICE
+        assert closed["breaker_state"] == brk.CLOSED
+        # tripped breaker: verdicts ride the host path and SAY so
+        assert tripped["degradation_tier"] == brk.TIER_HOST
+        assert tripped["breaker_state"] == brk.OPEN
+
+    def test_closed_server_rejects_with_server_closed(self):
+        server = BlsPoolServer(FakeInnerVerifier())
+
+        async def go():
+            await server.close()
+            return await server.handle_payload("t", _request("t"))
+
+        resp = codec.decode_response(run(go()))
+        assert resp["ok"] is False
+        assert resp["error"] == codec.ERR_SERVER_CLOSED
+
+    def test_close_shuts_down_inner_verifier(self):
+        inner = FakeInnerVerifier()
+        server = BlsPoolServer(inner)
+        run(server.close())
+        assert inner.closed is True
+
+
+class TestGcraWeightSemantics:
+    """Pins for the satellite: weight > quota is ALWAYS rejected and
+    never mutates the tenant's TAT; fractional emission intervals
+    accumulate exactly across mixed-weight calls."""
+
+    def _limiter(self, quota, window_ms):
+        t = {"now": 1000.0}
+        lim = RateLimiterGCRA(quota, window_ms, now=lambda: t["now"])
+        return lim, t
+
+    def test_overweight_rejected_without_mutating_tat(self):
+        lim, _ = self._limiter(10, 1000)
+        assert lim.allows("k", weight=11) is False
+        # the rejection left no TAT residue: the FULL burst is intact
+        assert lim.allows("k", weight=10) is True
+        # and now the window really is spent
+        assert lim.allows("k", weight=1) is False
+
+    def test_overweight_rejected_even_from_idle(self):
+        lim, t = self._limiter(10, 1000)
+        t["now"] += 3600.0  # an hour of idle earns no extra burst
+        assert lim.allows("k", weight=11) is False
+
+    def test_fractional_emission_accumulates_across_mixed_weights(self):
+        # quota 3 / 1000 ms -> emission interval 333.33… ms (fractional)
+        lim, t = self._limiter(3, 1000)
+        assert lim.allows("k", weight=2) is True
+        assert lim.allows("k", weight=1) is True  # 3 units: exactly full
+        assert lim.allows("k", weight=1) is False  # unit 4 over-burst
+        # one emission interval later exactly one unit has drained
+        t["now"] += 1000 / 3 / 1000 + 1e-6
+        assert lim.allows("k", weight=2) is False
+        assert lim.allows("k", weight=1) is True
+        assert lim.allows("k", weight=1) is False
+
+    def test_rejection_does_not_penalize_future_quota(self):
+        lim, t = self._limiter(4, 1000)
+        assert lim.allows("k", weight=4) is True
+        for _ in range(5):  # a shed flood hammers the closed window
+            assert lim.allows("k", weight=4) is False
+        # a full window later the full burst is back — the rejected
+        # calls mutated nothing
+        t["now"] += 1.0
+        assert lim.allows("k", weight=4) is True
+
+
+class TestChaos:
+    def _pair(self, **server_kwargs):
+        inner = FakeInnerVerifier()
+        server = BlsPoolServer(
+            inner, coalesce_wait_ms=server_kwargs.pop("coalesce_wait_ms", 5),
+            **server_kwargs,
+        )
+        client = RemoteBlsVerifier(
+            DirectTransport(server), tenant="chaos", attempts=2
+        )
+        return server, client
+
+    def test_request_drop_is_retried_then_served(self):
+        server, client = self._pair()
+
+        async def go():
+            try:
+                with faults.inject(
+                    "blspool.rpc.request",
+                    times=1,
+                    error=lambda: faults.Drop("blspool.rpc.request"),
+                ) as plan:
+                    verdict = await client.verify_signature_sets(make_sets(1))
+                return verdict, plan.calls, plan.fired
+            finally:
+                await client.close()
+                await server.close()
+
+        verdict, calls, fired = run(go())
+        assert verdict is True
+        assert (calls, fired) == (2, 1)  # dropped once, retried once
+        assert client.local_fallbacks == 0
+        assert client.last_stamp["degradation_tier"] == brk.TIER_HOST
+
+    def test_respond_fault_surfaces_as_transport_error_then_retry(self):
+        server, client = self._pair()
+
+        async def go():
+            try:
+                with faults.inject("blspool.rpc.respond", times=1) as plan:
+                    verdict = await client.verify_signature_sets(make_sets(1))
+                return verdict, plan.fired
+            finally:
+                await client.close()
+                await server.close()
+
+        verdict, fired = run(go())
+        # attempt 1 hit the crashing-server shape; attempt 2 served
+        assert verdict is True and fired == 1
+
+    def test_coalesce_fault_fails_batch_servably(self):
+        server, client = self._pair()
+
+        async def go():
+            try:
+                with faults.inject("blspool.batch.coalesce", times=1) as plan:
+                    verdict = await client.verify_signature_sets(make_sets(1))
+                return verdict, plan.fired
+            finally:
+                await client.close()
+                await server.close()
+
+        verdict, fired = run(go())
+        # batch 1 failed with an error RESPONSE (not a stranded waiter);
+        # the client's retry got a clean batch
+        assert verdict is True and fired == 1
+        assert len(server.batch_log) == 1
+
+    def test_all_attempts_dropped_degrades_to_local_host(self):
+        server, client = self._pair()
+        client._fallback = FakeInnerVerifier()  # keep the fallback fast
+
+        async def go():
+            try:
+                with faults.inject(
+                    "blspool.rpc.request",
+                    error=lambda: faults.Drop("blspool.rpc.request"),
+                ) as plan:
+                    verdict = await client.verify_signature_sets(make_sets(1))
+                return verdict, plan.fired
+            finally:
+                await client.close()
+                await server.close()
+
+        verdict, fired = run(go())
+        assert verdict is True  # a boolean verdict, never an exception
+        assert fired == 2  # both attempts lost
+        assert client.local_fallbacks == 1
+        assert client.last_stamp["degradation_tier"] == TIER_LOCAL_HOST
+        assert server.batch_log == []  # nothing ever reached the server
+
+
+class TestClientLadder:
+    def test_shed_then_clear_window_is_served_remotely(self):
+        inner = FakeInnerVerifier()
+        # quota 1 set / window: the first attempt's weight fills it,
+        # and the limiter's injectable clock lets attempt 2 clear it
+        t = {"now": 1000.0}
+        server = BlsPoolServer(
+            inner, coalesce_wait_ms=5, tenant_quota=(1, 1000),
+            now=lambda: t["now"],
+        )
+        client = RemoteBlsVerifier(
+            DirectTransport(server, tenant="t"), tenant="t", attempts=2
+        )
+
+        async def go():
+            try:
+                assert await client.verify_signature_sets(make_sets(1)) is True
+                # window now full: attempt 1 sheds; advance the clock so
+                # attempt 2 is admitted — the RETRY half of the ladder
+                t["now"] += 2.0
+                return await client.verify_signature_sets(make_sets(1))
+            finally:
+                await client.close()
+                await server.close()
+
+        assert run(go()) is True
+        assert client.local_fallbacks == 0
+        assert server.shed_log == []
+
+    def test_verify_on_main_thread_never_touches_the_wire(self):
+        server = BlsPoolServer(FakeInnerVerifier())
+        client = RemoteBlsVerifier(DirectTransport(server), tenant="t")
+
+        async def go():
+            try:
+                return await client.verify_signature_sets(
+                    make_sets(1), VerifyOptions(verify_on_main_thread=True)
+                )
+            finally:
+                await client.close()
+                await server.close()
+
+        assert run(go()) is True  # real local verification
+        assert server.batch_log == []
+
+    def test_empty_sets_is_false_without_wire_or_fallback(self):
+        server = BlsPoolServer(FakeInnerVerifier())
+        client = RemoteBlsVerifier(DirectTransport(server), tenant="t")
+
+        async def go():
+            try:
+                return await client.verify_signature_sets([])
+            finally:
+                await client.close()
+                await server.close()
+
+        assert run(go()) is False
+        assert client.local_fallbacks == 0 and server.batch_log == []
+
+
+class TestAotHygiene:
+    def test_every_coalescer_width_lands_on_a_registered_rung(self):
+        """The sidecar's only dispatch path is the inner pool, whose
+        widths quantize via pool_bucket — so every width the coalescer
+        can produce must land on an AOT-registered batch rung (the
+        sidecar can never force a cold compile)."""
+        from lodestar_tpu.aot.registry import registered_programs
+        from lodestar_tpu.chain.bls.device_pool import MAX_SIGNATURE_SETS_PER_JOB
+        from lodestar_tpu.ops.bls12_381 import buckets as bk
+
+        registered = {
+            p.bucket
+            for p in registered_programs("core", device_h2c=False)
+            if p.kernel == "batch"
+        }
+        assert set(bk.POOL_BUCKETS) <= registered
+        # boundary sweep: smallest, each rung edge, and the batch cap
+        widths = {1, MAX_SIGNATURE_SETS_PER_JOB}
+        for b in bk.POOL_BUCKETS:
+            widths.update({b - 1, b})
+        for w in sorted(w for w in widths if 1 <= w <= MAX_SIGNATURE_SETS_PER_JOB):
+            assert bk.pool_bucket(w) in registered, w
+        assert bk.align_down(MAX_SIGNATURE_SETS_PER_JOB) in registered

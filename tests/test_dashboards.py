@@ -22,12 +22,14 @@ _DERIVED = ("_bucket", "_sum", "_count", "_total", "_created")
 def _exported_names() -> set:
     from prometheus_client import CollectorRegistry, generate_latest
 
+    from lodestar_tpu.blspool.metrics import BlsPoolSidecarMetrics
     from lodestar_tpu.chain.bls.metrics import BlsPoolMetrics
     from lodestar_tpu.metrics import Metrics
 
     reg = CollectorRegistry()
     m = Metrics(registry=reg)
     BlsPoolMetrics(registry=reg)
+    BlsPoolSidecarMetrics(registry=reg)
     text = generate_latest(reg).decode()
     names = set()
     for line in text.splitlines():
@@ -105,6 +107,43 @@ def test_bls_pool_dashboard_pins_breaker_and_degradation_series():
     exported_bases = {_base(n) for n in _exported_names()}
     unexported = {
         s for s in _PINNED_BLS_FAULT_SERIES if _base(s) not in exported_bases
+    }
+    assert not unexported, f"pinned series not exported: {sorted(unexported)}"
+
+
+# Sidecar fault-domain + fairness series (ISSUE 16): a tenant being
+# shed, a pool serving host fallbacks instead of device verdicts, and a
+# client quietly living off its local oracle must all be VISIBLE on the
+# shipped sidecar board — pinned both directions, like the BLS pool's.
+_PINNED_BLSPOOL_SERIES = {
+    "lodestar_tpu_blspool_requests_total",
+    "lodestar_tpu_blspool_shed_total",
+    "lodestar_tpu_blspool_batch_width",
+    "lodestar_tpu_blspool_batch_tenants",
+    "lodestar_tpu_blspool_responses_total",
+    "lodestar_tpu_blspool_client_local_fallbacks_total",
+}
+
+
+def test_blspool_dashboard_pins_tenancy_and_degradation_series():
+    path = os.path.join(_DASH_DIR, "lodestar_tpu_blspool.json")
+    dash = json.load(open(path))
+    targeted = set()
+    for panel in dash.get("panels", []):
+        for target in panel.get("targets", []):
+            targeted.update(_METRIC_RE.findall(target.get("expr", "")))
+    targeted_bases = {_base(n) for n in targeted}
+    missing = {
+        s for s in _PINNED_BLSPOOL_SERIES
+        if s not in targeted and _base(s) not in targeted_bases
+    }
+    assert not missing, (
+        f"blspool dashboard lost its tenancy panels: {sorted(missing)}"
+    )
+    # and the exporter really exports them (both directions pinned)
+    exported_bases = {_base(n) for n in _exported_names()}
+    unexported = {
+        s for s in _PINNED_BLSPOOL_SERIES if _base(s) not in exported_bases
     }
     assert not unexported, f"pinned series not exported: {sorted(unexported)}"
 
