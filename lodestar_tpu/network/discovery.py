@@ -283,6 +283,11 @@ class UdpEndpoint:
     def close(self) -> None:
         if self._transport is not None:
             self._transport.close()
+        # in-flight datagram handlers must not outlive the endpoint: a
+        # handler resumed after close() would touch a dead transport
+        for task in self._tasks:
+            task.cancel()
+        self._tasks.clear()
 
 
 # ---------------------------------------------------------------------------

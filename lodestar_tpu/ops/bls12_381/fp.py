@@ -22,11 +22,17 @@ and no log-depth shift networks.  On TPU the (30, 30) tile is VPU-friendly.
 Limb shifts (multiply/divide by the radix) are likewise static matmuls
 (x @ SHIFT) instead of concatenates, for the same compile-time reason.
 
-Overflow audit for mont_mul (uint32, b = 2^13-1 = 8191):
-  * product a_i*b_j <= 8191^2 = 67,092,481 < 2^27
-  * a column receives at most NLIMBS products from a*b and NLIMBS from m*p:
-    2*30*8191^2 = 4,025,548,860, plus one shift carry < 2^20
-    -> max 4,026,597,309 < 2^32 - 1.   No wraparound.
+Overflow soundness is machine-checked, not hand-audited: the
+``limb-bounds`` lodelint rule (tools/lint/rules_bounds.py) abstract-
+interprets this module and proves, per assignment, that no uint32
+expression can reach 2^32 and no implicit dtype promotion sneaks in.
+Entry points carry machine-readable ``@bounds:`` contracts in their
+docstrings — grammar and suppression semantics in docs/LINT.md
+("lodelint v4").  The headline CIOS bound the rule re-derives on every
+run — a column receives at most ``2*NLIMBS*(2^13-1)^2 + carry < 2^32``
+— is also recomputed from the actual LIMB_BITS/NLIMBS constants by
+tests/test_limb_bounds_audit.py, so a radix change cannot leave a
+stale audit behind.
 """
 from __future__ import annotations
 
@@ -219,11 +225,19 @@ def _flat_leading(fn):
 
 @_flat_leading
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b mod p on canonical limbs.
+
+    @bounds: a [0, 2^13-1], b [0, 2^13-1] -> [0, 2^13-1]
+    """
     return _cond_sub_p(_resolve_single_carries(a + b))
 
 
 @_flat_leading
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b mod p on canonical limbs.
+
+    @bounds: a [0, 2^13-1], b [0, 2^13-1] -> [0, 2^13-1]
+    """
     d, borrow = _borrow_sub(a, b)
     # Where a < b the limbs represent a-b+2^390; adding p and dropping the
     # top carry (exactly 2^390) yields a-b+p in [0, p).
@@ -232,10 +246,18 @@ def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
+    """-a mod p.
+
+    @bounds: a [0, 2^13-1] -> [0, 2^13-1]
+    """
     return sub(jnp.zeros_like(a), a)
 
 
 def dbl(a: jnp.ndarray) -> jnp.ndarray:
+    """2a mod p.
+
+    @bounds: a [0, 2^13-1] -> [0, 2^13-1]
+    """
     return add(a, a)
 
 
@@ -252,7 +274,10 @@ def _cios_step(u, a_i, b):
 def mont_mul_cios(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Montgomery product via the serial CIOS scan (kept as the reference
     implementation / fallback; the default mont_mul is the parallel
-    full-product reduction below)."""
+    full-product reduction below).
+
+    @bounds: a [0, 2^13-1], b [0, 2^13-1] -> [0, 2^13-1]
+    """
     a, b = jnp.broadcast_arrays(a, b)
     if CIOS_UNROLL:
         u = jnp.zeros_like(b)
@@ -364,6 +389,8 @@ def _use_pallas() -> bool:
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Montgomery product a*b*R^{-1} mod p, canonical output.
 
+    @bounds: a [0, 2^13-1], b [0, 2^13-1] -> [0, 2^13-1]
+
     Backend dispatch (trace-time):
       * tpu  -> Pallas fused kernel (pallas_fp.py; bandwidth-optimal)
       * else -> serial CIOS scan (mont_mul_cios): XLA:CPU compiles the
@@ -390,7 +417,10 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 @_flat_leading
 def mont_mul_parallel(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """The parallel (no serial limb scan) XLA expression form."""
+    """The parallel (no serial limb scan) XLA expression form.
+
+    @bounds: a [0, 2^13-1], b [0, 2^13-1] -> [0, 2^13-1]
+    """
     # U = a*b: 59 limbs <= 30*8191^2 < 2^31
     u = _conv(a, b, _IDX_FULL)
     # two widening passes: limbs <= 8191 + 31 (=: B1), width 61
@@ -413,16 +443,26 @@ def mont_mul_parallel(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    """a^2 in Montgomery form.
+
+    @bounds: a [0, 2^13-1] -> [0, 2^13-1]
+    """
     return mont_mul(a, a)
 
 
 def to_mont(a: jnp.ndarray) -> jnp.ndarray:
-    """Plain limbs (value < p) -> Montgomery form."""
+    """Plain limbs (value < p) -> Montgomery form.
+
+    @bounds: a [0, 2^13-1] -> [0, 2^13-1]
+    """
     return mont_mul(a, jnp.broadcast_to(_R2, a.shape))
 
 
 def from_mont(a: jnp.ndarray) -> jnp.ndarray:
-    """Montgomery form -> plain canonical limbs."""
+    """Montgomery form -> plain canonical limbs.
+
+    @bounds: a [0, 2^13-1] -> [0, 2^13-1]
+    """
     one = jnp.zeros_like(a).at[..., 0].set(1)
     return mont_mul(a, one)
 
@@ -459,6 +499,8 @@ def mont_pow_fixed(a: jnp.ndarray, e: int) -> jnp.ndarray:
     table-lookup multiply per window.  Halves the multiply count of the
     bitwise square-and-multiply ladder (the Fermat inversions a^(p-2) are
     ~15% of the whole verification program's op count).
+
+    @bounds: a [0, 2^13-1], e host -> [0, 2^13-1]
     """
     if e == 0:
         return jnp.broadcast_to(_ONE_M, a.shape)
@@ -501,6 +543,8 @@ def inv(a: jnp.ndarray) -> jnp.ndarray:
     """Multiplicative inverse via Fermat (a^(p-2)); a in Montgomery form.
 
     inv(0) returns 0 (callers guard; matches constant-shape control flow).
+
+    @bounds: a [0, 2^13-1] -> [0, 2^13-1]
     """
     from lodestar_tpu.crypto.bls.fields import P
 

@@ -212,7 +212,10 @@ def _mont_mul_limbs_first(a2T, b2T, *, interpret: bool):
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
     """Drop-in fused replacement for fp.mont_mul (canonical in/out,
     ``(..., NLIMBS)`` convention; boundary transposes are fused away by
-    XLA).  `interpret=True` runs the Pallas interpreter (CPU tests)."""
+    XLA).  `interpret=True` runs the Pallas interpreter (CPU tests).
+
+    @bounds: a [0, 2^13-1], b [0, 2^13-1], interpret host -> [0, 2^13-1]
+    """
     a, b = jnp.broadcast_arrays(a, b)
     aT, lead, n = _prep(a)
     bT, _, _ = _prep(b)
@@ -253,7 +256,10 @@ def _limb_specs(n_data: int):
 
 
 def f2_mul(a, b, *, interpret: bool = False):
-    """Fused tower.f2_mul: ((..,30),(..,30)) x 2 -> 2-tuple."""
+    """Fused tower.f2_mul: ((..,30),(..,30)) x 2 -> 2-tuple.
+
+    @bounds: a [0, 2^13-1], b [0, 2^13-1], interpret host -> [0, 2^13-1]
+    """
     from jax.experimental import pallas as pl
 
     a0, a1, b0, b1 = jnp.broadcast_arrays(a[0], a[1], b[0], b[1])
@@ -277,7 +283,10 @@ def f2_mul(a, b, *, interpret: bool = False):
 
 
 def f2_sqr(a, *, interpret: bool = False):
-    """Fused tower.f2_sqr."""
+    """Fused tower.f2_sqr.
+
+    @bounds: a [0, 2^13-1], interpret host -> [0, 2^13-1]
+    """
     from jax.experimental import pallas as pl
 
     a0, a1 = jnp.broadcast_arrays(a[0], a[1])

@@ -105,13 +105,20 @@ class JobItemQueue(Generic[T, R]):
             result = await self._process(item)
             if not fut.done():
                 fut.set_result(result)
+        except asyncio.CancelledError:
+            # abort() cancelled us: the caller awaiting the future must
+            # see the queue-level error, not a bare cancellation
+            if not fut.done():
+                fut.set_exception(QueueAbortedError(self.name))
+            raise
         except Exception as e:
             if not fut.done():
                 fut.set_exception(e)
         finally:
             self.metrics.total_jobs += 1
             self._running -= 1
-            self._pump()
+            if not self._aborted:
+                self._pump()
 
     def abort(self) -> None:
         self._aborted = True
@@ -119,4 +126,8 @@ class JobItemQueue(Generic[T, R]):
             _, fut = self._items.popleft()
             if not fut.done():
                 fut.set_exception(QueueAbortedError(self.name))
+        # in-flight jobs: cancel rather than strand them running against
+        # an aborted queue (their futures resolve in _run's handler)
+        for task in tuple(self._tasks):
+            task.cancel()
         self.metrics.length = 0

@@ -82,6 +82,8 @@ _E2E_FILES = {
     "test_blinded_block_flow.py",
     "test_checkpoint_sync_and_builder.py",
     "test_discovery_and_merge.py",
+    "test_blspool_process.py",
+    "test_blspool_swarm.py",
     "test_wire_transport.py",
     "test_dryrun_artifact.py",
     "test_official_vectors.py",
@@ -117,6 +119,8 @@ _FAST_FILES = {
     "test_adversarial_el.py",
     "test_altair.py",
     "test_aot.py",
+    "test_bls_conformance_vectors.py",
+    "test_blspool.py",
     "test_dashboards.py",
     "test_db.py",
     "test_engine_http.py",
@@ -127,6 +131,8 @@ _FAST_FILES = {
     "test_gossip_scoring.py",
     "test_incremental_merkle.py",
     "test_kzg.py",
+    "test_lifecycle_regressions.py",
+    "test_limb_bounds_audit.py",
     "test_lodelint.py",
     "test_mesh_smoke.py",
     "test_metrics.py",

@@ -338,6 +338,31 @@ class TestCacheConfig:
         # removing twice is a no-op, not an error
         aot_cache.remove_cache_spy_callback(cb)
 
+    def test_put_fault_leaves_cache_cold(self, tmp_cache):
+        """aot.cache.put chaos: an injected write failure must not break
+        compilation (jax absorbs it with a warning) but the entry is
+        never persisted — a fresh identical trace misses, not hits."""
+        import warnings
+
+        from lodestar_tpu.testing import faults
+
+        aot_cache.install_cache_spy()
+        aot_cache.reset_stats()
+        prog = TinyProg(bucket=16, salt=7.5)
+        with faults.inject("aot.cache.put") as plan:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", UserWarning)
+                prog.fn()(*prog.example_args())  # compiles despite the fault
+        assert plan.fired >= 1
+        assert aot_cache.cache_stats()["puts"] == 0
+        faults.reset()
+        aot_cache.reset_stats()
+        prog2 = TinyProg(bucket=16, salt=7.5)
+        prog2.fn()(*prog2.example_args())
+        stats = aot_cache.cache_stats()
+        assert stats["hits"] == 0, "a failed put must not leave an entry"
+        assert stats["misses"] >= 1 and stats["puts"] >= 1
+
     def test_entry_exists_both_layouts(self, tmp_path):
         d = str(tmp_path)
         open(os.path.join(d, "k1-cache"), "w").close()

@@ -1,0 +1,92 @@
+"""Regression tests for the lodelint v4 task-lifecycle findings.
+
+The rule proved two real leaks: ``UdpEndpoint.close()`` never cancelled
+its in-flight datagram-handler tasks, and ``JobItemQueue.abort()``
+stranded running jobs (their futures never resolved, so callers hung).
+These tests pin the fixes.
+"""
+import asyncio
+
+import pytest
+
+from lodestar_tpu.network.discovery import UdpEndpoint
+from lodestar_tpu.utils.queue import JobItemQueue, QueueAbortedError
+
+
+def run(coro):
+    loop = asyncio.get_event_loop_policy().new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_udp_endpoint_close_cancels_inflight_handlers():
+    async def go():
+        ep = UdpEndpoint()
+        started = asyncio.Event()
+        cancelled = asyncio.Event()
+
+        async def receiver(from_addr, data):
+            started.set()
+            try:
+                await asyncio.sleep(3600)
+            except asyncio.CancelledError:
+                cancelled.set()
+                raise
+
+        await ep.open("127.0.0.1", 0, receiver)
+        port = ep._transport.get_extra_info("sockname")[1]
+        await ep.send("me", f"127.0.0.1:{port}", b"ping")
+        await asyncio.wait_for(started.wait(), 5.0)
+        ep.close()
+        await asyncio.wait_for(cancelled.wait(), 5.0)
+        assert not ep._tasks, "close() left handler tasks tracked"
+
+    run(go())
+
+
+def test_queue_abort_cancels_inflight_jobs():
+    async def go():
+        started = asyncio.Event()
+
+        async def process(item):
+            started.set()
+            await asyncio.sleep(3600)
+
+        q = JobItemQueue(process, name="abort-regression")
+        fut = q.push("job")
+        await asyncio.wait_for(started.wait(), 5.0)
+        q.abort()
+        # the in-flight job's caller sees the queue-level error, not a
+        # hang or a bare CancelledError
+        with pytest.raises(QueueAbortedError):
+            await asyncio.wait_for(fut, 5.0)
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert not q._tasks, "abort() left in-flight tasks running"
+
+    run(go())
+
+
+def test_queue_abort_fails_pending_and_rejects_new_pushes():
+    async def go():
+        gate = asyncio.Event()
+
+        async def process(item):
+            await gate.wait()
+            return item
+
+        q = JobItemQueue(process, max_concurrency=1, name="abort-pending")
+        running = q.push(1)
+        queued = q.push(2)
+        await asyncio.sleep(0)
+        q.abort()
+        with pytest.raises(QueueAbortedError):
+            await asyncio.wait_for(queued, 5.0)
+        with pytest.raises(QueueAbortedError):
+            await asyncio.wait_for(running, 5.0)
+        with pytest.raises(QueueAbortedError):
+            q.push(3)
+
+    run(go())

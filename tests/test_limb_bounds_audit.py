@@ -1,0 +1,50 @@
+"""The fp.py overflow audit, recomputed from the real radix constants.
+
+The prose "Overflow audit" in fp.py's docstring became machine-checked
+in lodelint v4 (the ``limb-bounds`` abstract interpreter); this test is
+the belt to that suspenders — it re-derives the headline CIOS column
+bound ``2*NLIMBS*(2^13-1)^2 + carry < 2^32`` from the ACTUAL
+``LIMB_BITS``/``NLIMBS`` values, so a future radix change cannot ship
+with a stale audit.  Host-side integer math only (no jax import).
+"""
+from lodestar_tpu.ops.bls12_381.limbs import LIMB_BITS, MASK, NLIMBS, P, R, R_EXP
+
+
+def test_cios_column_bound_fits_uint32():
+    mask = (1 << LIMB_BITS) - 1
+    assert MASK == mask
+    # a CIOS column receives at most NLIMBS products from a*b and NLIMBS
+    # from m*p, each <= (2^LIMB_BITS - 1)^2
+    column = 2 * NLIMBS * mask * mask
+    # the shift carry feeding back into the column is the fixpoint of
+    # carry = (column + carry) >> LIMB_BITS
+    carry = 0
+    for _ in range(2 * NLIMBS):
+        carry = (column + carry) >> LIMB_BITS
+    assert (column + carry) >> LIMB_BITS == carry, "carry not at fixpoint"
+    assert column + carry < 2**32, (
+        f"CIOS column max {column + carry} wraps uint32 at "
+        f"LIMB_BITS={LIMB_BITS}, NLIMBS={NLIMBS}"
+    )
+
+
+def test_cios_bound_is_load_bearing():
+    """The uint32 headroom is real, not vacuous: doubling the limb count
+    (the mutation the limbcheck gate must catch) overflows."""
+    mask = (1 << LIMB_BITS) - 1
+    assert 2 * (2 * NLIMBS) * mask * mask >= 2**32
+
+
+def test_parallel_form_conv_bound_fits_uint32():
+    """mont_mul_parallel's convolutions: after two widening carry passes
+    limbs are <= MASK + ~NLIMBS+1, and a low/full conv column sums
+    NLIMBS products of that against canonical limbs."""
+    mask = (1 << LIMB_BITS) - 1
+    widened = mask + NLIMBS + 1
+    assert NLIMBS * widened * mask < 2**31
+
+
+def test_montgomery_radix_invariants():
+    assert NLIMBS * LIMB_BITS == R_EXP
+    assert R == 1 << R_EXP
+    assert R > 2 * P, "Montgomery reduction needs R > 2p for [0, 2p) output"

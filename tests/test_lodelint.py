@@ -1687,7 +1687,7 @@ def test_lint_stamp_covers_every_analyzer_module():
     for required in (
         "core.py", "callgraph.py", "effects.py", "rules_async.py",
         "rules_jax.py", "rules_repo.py", "rules_interproc.py",
-        "rules_program.py",
+        "rules_program.py", "rules_bounds.py",
     ):
         assert required in on_disk
     for fn in on_disk:
@@ -1961,3 +1961,419 @@ def test_sanitizer_build_reports_missing_source_cleanly(monkeypatch, tmp_path):
     monkeypatch.setattr(sanitize, "_DEPS", sanitize._DEPS + [missing])
     ok, msg = sanitize.build("cc", out=str(tmp_path / "drv"))
     assert not ok and "cannot stat" in msg
+
+
+# ---------------------------------------------------------------------------
+# lodelint v4: limb-bounds (the limbcheck abstract interpreter)
+#
+# Fixtures opt into the interpreter's scope by carrying an ``@bounds:``
+# token (callgraph.bounds_in_scope); the real kernel modules are in
+# scope by path.  LIMB_BITS/NLIMBS module consts reseed the canonical
+# interval, so the doubled-limb-count mutation demo is a pure fixture.
+# ---------------------------------------------------------------------------
+
+
+def test_limb_bounds_negative_canonical_add_within_annotation():
+    src = """
+    LIMB_BITS = 13
+    NLIMBS = 30
+    def add(a, b):
+        '''@bounds: a [0, 2^13-1], b [0, 2^13-1] -> [0, 2^14-1]'''
+        return a + b
+    """
+    assert not lint(src, rule="limb-bounds")
+
+
+def test_limb_bounds_positive_deliberate_wrap_reports_at_wrap_site():
+    # mod-2^32 wraparound is SILENT at the wrapping add; the finding
+    # fires at the taint-incompatible >> use, anchored at the wrap site,
+    # carrying the full interval derivation chain
+    src = """
+    # fixture opts in via @bounds: marker
+    LIMB_BITS = 13
+    NLIMBS = 30
+    def column(a, b):
+        prods = a * b
+        col = 2 * NLIMBS * prods
+        doubled = col + col
+        return doubled >> LIMB_BITS
+    """
+    fs = lint(src, rule="limb-bounds")
+    assert [f.rule for f in fs] == ["limb-bounds"]
+    f = fs[0]
+    assert f.line == 8  # the wrapping `col + col`, not the shift
+    assert "exceeds 2^32 - 1" in f.message and "RShift" in f.message
+    # the chain reconstructs the derivation down to the limb products
+    assert any("a * b -> [0, 67092481]" in fr for fr in f.chain)
+    assert "[0, 8051097720]" in f.chain[-1]
+
+
+def test_limb_bounds_negative_mask_forgives_deliberate_wrap():
+    # & (2^k - 1) is a ring homomorphism mod 2^k: the same wrapped value
+    # masked back to canonical is NOT a finding
+    src = """
+    # fixture opts in via @bounds: marker
+    LIMB_BITS = 13
+    NLIMBS = 30
+    MASK = (1 << LIMB_BITS) - 1
+    def column(a, b):
+        prods = a * b
+        col = 2 * NLIMBS * prods
+        doubled = col + col
+        return doubled & MASK
+    """
+    assert not lint(src, rule="limb-bounds")
+
+
+def test_limb_bounds_positive_interval_widening_through_for_loop():
+    # a bounded loop whose body grows the interval each trip: the joined
+    # fixpoint crosses 2^32 and the shift use reports with the widening
+    # steps visible in the chain
+    src = """
+    # fixture opts in via @bounds: marker
+    LIMB_BITS = 13
+    NLIMBS = 30
+    def runaway(a):
+        acc = a
+        for _ in range(NLIMBS):
+            acc = acc * 2 + a
+        return acc >> 1
+    """
+    fs = lint(src, rule="limb-bounds")
+    assert [f.rule for f in fs] == ["limb-bounds"]
+    assert "exceeds 2^32 - 1" in fs[0].message
+    assert len(fs[0].chain) >= 2  # successive widening frames survive
+
+
+def test_limb_bounds_positive_unknown_trip_count_loop_demands_bounds():
+    # an unbounded while joins toward top: the canonical operand meeting
+    # the widened accumulator is exactly the unprovable case
+    src = """
+    # fixture opts in via @bounds: marker
+    LIMB_BITS = 13
+    NLIMBS = 30
+    def runaway(a, flags):
+        acc = a
+        while flags:
+            acc = acc + a
+        return acc >> 1
+    """
+    fs = lint(src, rule="limb-bounds")
+    assert [f.rule for f in fs] == ["limb-bounds"]
+    assert "cannot bound" in fs[0].message
+
+
+def test_limb_bounds_mutation_demo_doubled_nlimbs_overflows_cios_column():
+    # THE acceptance mutation: the real fp.py CIOS column bound
+    # 2*NLIMBS*(2^13-1)^2 + carry < 2^32 holds at NLIMBS=30 and breaks
+    # at 60 — the gate must go red on the doubled-limb-count kernel
+    tmpl = """
+    # fixture opts in via @bounds: marker
+    LIMB_BITS = 13
+    NLIMBS = {n}
+    def cios_col(a, b, m, p):
+        col = NLIMBS * (a * b) + NLIMBS * (m * p)
+        return col >> LIMB_BITS
+    """
+    assert not lint(tmpl.format(n=30), rule="limb-bounds")
+    fs = lint(tmpl.format(n=60), rule="limb-bounds")
+    assert [f.rule for f in fs] == ["limb-bounds"]
+    assert "8051097720" in fs[0].message  # 2*60*8191^2, computed not guessed
+
+
+def test_limb_bounds_positive_implicit_dtype_promotion():
+    src = """
+    # fixture opts in via @bounds: marker
+    import jax.numpy as jnp
+    def f(a):
+        scale = a.astype(jnp.float32)
+        return a + scale
+    """
+    fs = lint(src, rule="limb-bounds")
+    assert [f.rule for f in fs] == ["limb-bounds"]
+    assert "implicit dtype promotion: u32 op f32" in fs[0].message
+
+
+def test_limb_bounds_positive_untracked_operand_is_unprovable():
+    src = """
+    # fixture opts in via @bounds: marker
+    import os
+    def f(a):
+        x = os.environ.whatever()
+        return a + x
+    """
+    fs = lint(src, rule="limb-bounds")
+    assert [f.rule for f in fs] == ["limb-bounds"]
+    assert "untracked operand" in fs[0].message
+    assert "@bounds:" in fs[0].message  # the fix the message demands
+
+
+def test_limb_bounds_suppression_is_honored_at_the_finding_line():
+    src = """
+    # fixture opts in via @bounds: marker
+    import os
+    def f(a):
+        x = os.environ.whatever()
+        return a + x  # lodelint: disable=limb-bounds
+    """
+    assert not lint(src, rule="limb-bounds")
+
+
+def test_limb_bounds_annotation_violated_by_body_return():
+    # @bounds: is a verified contract, not a trusted comment: a body
+    # returning wider than it declares is a finding at the return site
+    src = """
+    LIMB_BITS = 13
+    def mul(a, b):
+        '''@bounds: a [0, 2^13-1], b [0, 2^13-1] -> [0, 2^13-1]'''
+        return a * b
+    """
+    fs = lint(src, rule="limb-bounds")
+    assert [f.rule for f in fs] == ["limb-bounds"]
+    assert "exceeding its declared @bounds return" in fs[0].message
+
+
+def test_limb_bounds_annotation_checked_against_call_site_args():
+    # the caller side of the contract: a value proven wider than the
+    # callee's declared param interval is a finding at the call
+    src = """
+    LIMB_BITS = 13
+    def widen2(a):
+        '''@bounds: a [0, 2^13-1] -> [0, 2^14-1]'''
+        return a + a
+    def narrow(x):
+        '''@bounds: x [0, 2^13-1] -> [0, 2^13-1]'''
+        return x
+    def caller(a):
+        w = widen2(a)
+        return narrow(w)
+    """
+    fs = lint(src, rule="limb-bounds")
+    assert [f.rule for f in fs] == ["limb-bounds"]
+    assert "outside its declared @bounds [0, 8191]" in fs[0].message
+
+
+def test_limb_bounds_json_payload_carries_interval_chain():
+    # satellite: --json consumers (editor integrations) get the interval
+    # derivation as structured data, pinned here as schema
+    src = """
+    # fixture opts in via @bounds: marker
+    LIMB_BITS = 13
+    NLIMBS = 30
+    def column(a, b):
+        prods = a * b
+        col = 2 * NLIMBS * prods
+        doubled = col + col
+        return doubled >> LIMB_BITS
+    """
+    d = lint(src, rule="limb-bounds")[0].as_json()
+    assert set(d) == {"path", "line", "col", "rule", "message", "effects",
+                      "chain"}
+    assert d["rule"] == "limb-bounds"
+    assert d["effects"] == ["overflow"]
+    # chain frames are `path:line expr -> [lo, hi] (dtype)` strings
+    assert d["chain"] and all(" -> [" in fr and "(u32)" in fr
+                              for fr in d["chain"])
+
+
+# ---------------------------------------------------------------------------
+# lodelint v4: fault-coverage
+# ---------------------------------------------------------------------------
+
+
+def _fault_project(fire_src: str, test_src: str):
+    mod = callgraph.summary_for_source(
+        textwrap.dedent(fire_src), "lodestar_tpu/fixture_mod.py"
+    )
+    tests = callgraph.summary_for_source(
+        textwrap.dedent(test_src), "tests/test_fixture_chaos.py"
+    )
+    return callgraph.build_project([mod, tests])
+
+
+def test_fault_coverage_positive_undocumented_checkpoint():
+    src = """
+    from lodestar_tpu.testing import faults
+    def f():
+        faults.fire("fixture.bogus.point")
+    """
+    fs = lint(src, rule="fault-coverage")
+    assert [f.rule for f in fs] == ["fault-coverage"]
+    assert "no row in docs/FAULTS.md" in fs[0].message
+
+
+def test_fault_coverage_fstring_checkpoint_name_resolves_statically():
+    # the name is an f-string over a module str constant: coverage
+    # checking sees the RESOLVED name, not an opaque expression
+    src = """
+    from lodestar_tpu.testing import faults
+    _POINT = "bogus"
+    def f():
+        faults.fire(f"fixture.{_POINT}.point")
+    """
+    fs = lint(src, rule="fault-coverage")
+    assert [f.rule for f in fs] == ["fault-coverage"]
+    assert "'fixture.bogus.point'" in fs[0].message
+
+
+def test_fault_coverage_positive_unresolvable_checkpoint_name():
+    src = """
+    from lodestar_tpu.testing import faults
+    def f(name):
+        faults.fire(name)
+    """
+    fs = lint(src, rule="fault-coverage")
+    assert [f.rule for f in fs] == ["fault-coverage"]
+    assert "not statically resolvable" in fs[0].message
+
+
+def test_fault_coverage_mutation_demo_documented_but_untested():
+    # THE acceptance mutation: net.transport.write has its FAULTS.md row,
+    # but the project's only chaos test injects a different point —
+    # exactly what deleting the write-fault chaos test would leave behind
+    p = _fault_project(
+        """
+        from lodestar_tpu.testing import faults
+        def send():
+            faults.fire("net.transport.write")
+        """,
+        """
+        from lodestar_tpu.testing import faults
+        def test_chaos():
+            with faults.inject("net.transport.read"):
+                pass
+        """,
+    )
+    fs = RULES["fault-coverage"].check_project(p)
+    assert [f.rule for f in fs] == ["fault-coverage"]
+    assert "no test ever injects it" in fs[0].message
+    assert fs[0].path == "lodestar_tpu/fixture_mod.py"
+
+
+def test_fault_coverage_negative_documented_and_injected():
+    p = _fault_project(
+        """
+        from lodestar_tpu.testing import faults
+        def send():
+            faults.fire("net.transport.write")
+        """,
+        """
+        from lodestar_tpu.testing import faults
+        def test_chaos():
+            with faults.inject("net.transport.write"):
+                pass
+        """,
+    )
+    assert not RULES["fault-coverage"].check_project(p)
+
+
+# ---------------------------------------------------------------------------
+# lodelint v4: task-lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_task_lifecycle_mutation_demo_attr_task_never_cancelled():
+    # THE acceptance mutation: a tracked task whose owner HAS a close()
+    # that simply forgets to cancel it — the PR-15 heartbeat leak shape
+    src = """
+    import asyncio
+    class Svc:
+        def start(self):
+            self._hb = asyncio.create_task(self._beat())
+        async def _beat(self):
+            pass
+        async def close(self):
+            pass
+    """
+    fs = lint(src, rule="task-lifecycle")
+    assert [f.rule for f in fs] == ["task-lifecycle"]
+    assert "'_hb'" in fs[0].message
+    assert "never cancelled or awaited" in fs[0].message
+
+
+def test_task_lifecycle_negative_cancelled_on_close():
+    src = """
+    import asyncio
+    class Svc:
+        def start(self):
+            self._hb = asyncio.create_task(self._beat())
+        async def _beat(self):
+            pass
+        async def close(self):
+            self._hb.cancel()
+    """
+    assert not lint(src, rule="task-lifecycle")
+
+
+def test_task_lifecycle_negative_cancel_reached_through_helper():
+    # close() -> _teardown() -> cancel: settlement is call-graph
+    # reachability from lifecycle roots, not a same-body string match
+    src = """
+    import asyncio
+    class Svc:
+        def start(self):
+            self._hb = asyncio.create_task(self._beat())
+        async def _beat(self):
+            pass
+        def _teardown(self):
+            self._hb.cancel()
+        async def close(self):
+            self._teardown()
+    """
+    assert not lint(src, rule="task-lifecycle")
+
+
+def test_task_lifecycle_positive_owner_has_no_lifecycle_method():
+    src = """
+    import asyncio
+    class Svc:
+        def start(self):
+            self._hb = asyncio.create_task(self._beat())
+        async def _beat(self):
+            pass
+    """
+    fs = lint(src, rule="task-lifecycle")
+    assert [f.rule for f in fs] == ["task-lifecycle"]
+    assert "no close()/stop() lifecycle method" in fs[0].message
+
+
+def test_task_lifecycle_positive_local_task_leaks():
+    src = """
+    import asyncio
+    async def leak():
+        t = asyncio.create_task(g())
+        print("spawned")
+    async def g():
+        pass
+    """
+    fs = lint(src, rule="task-lifecycle")
+    assert [f.rule for f in fs] == ["task-lifecycle"]
+    assert "outlives its owner" in fs[0].message
+
+
+def test_task_lifecycle_negative_local_task_awaited():
+    src = """
+    import asyncio
+    async def ok():
+        t = asyncio.create_task(g())
+        await t
+    async def g():
+        pass
+    """
+    assert not lint(src, rule="task-lifecycle")
+
+
+def test_task_lifecycle_negative_collection_cancelled_via_alias():
+    # stop() snapshots the set into a local before cancelling — the
+    # UdpEndpoint/JobItemQueue idiom; alias expansion must see through it
+    src = """
+    import asyncio
+    class Pool:
+        def start(self):
+            self._tasks.add(asyncio.create_task(w()))
+        def stop(self):
+            tasks = list(self._tasks)
+            for t in tasks:
+                t.cancel()
+    """
+    assert not lint(src, rule="task-lifecycle")

@@ -602,3 +602,197 @@ def test_network_heartbeat_wires_the_pruning():
             swarm.close()
 
     run(go())
+
+
+# ---------------------------------------------------------------------------
+# chaos coverage for the documented transport/gossip/reqresp/sync seams
+# (lodelint fault-coverage: every docs/FAULTS.md checkpoint must be
+# exercised by at least one inject() plan)
+# ---------------------------------------------------------------------------
+
+
+def test_connect_fault_fails_dial_then_reconnect_recovers():
+    """net.transport.connect (loopback binding): an injected connect
+    fault surfaces to the dialer and a later redial succeeds."""
+
+    async def go():
+        net = LoopbackNet()
+        a = net.register(MeshFabric("cf-a"))
+        b = net.register(MeshFabric("cf-b"))
+        with faults.inject("net.transport.connect", times=1) as plan:
+            with pytest.raises(faults.FaultError):
+                await net.connect(a, b)
+            assert b.peer_id not in a.conns
+            # schedule exhausted: the redial goes through
+            await net.connect(a, b)
+            assert plan.fired == 1
+        assert b.peer_id in a.conns and a.peer_id in b.conns
+        net.close()
+
+    run(go())
+
+
+def test_connect_fault_fails_tcp_dial():
+    """net.transport.connect (OS-socket binding): the same seam guards
+    WireTransport.dial, scoped to the outbound side by match=."""
+
+    async def go():
+        from lodestar_tpu.network.wire import WireTransport
+
+        a = WireTransport(insecure=True)
+        b = WireTransport(insecure=True)
+        try:
+            await b.listen()
+
+            def outbound(src=None, **_ctx):
+                return src == a.peer_id
+
+            with faults.inject(
+                "net.transport.connect", times=1, match=outbound
+            ) as plan:
+                with pytest.raises(faults.FaultError):
+                    await a.dial("127.0.0.1", b.listen_port)
+                peer = await a.dial("127.0.0.1", b.listen_port)
+                assert peer == b.peer_id
+                assert plan.fired == 1
+        finally:
+            a.close()
+            b.close()
+
+    run(go())
+
+
+def test_write_fault_drops_frames_and_recovers():
+    """net.transport.write: Drop on the sender's frames loses the
+    request in flight (bounded timeout, no wedge); healthy after."""
+
+    async def go():
+        net = LoopbackNet()
+        a = net.register(MeshFabric("wf-a", request_timeout=0.3))
+        b = net.register(MeshFabric("wf-b"))
+        await net.connect(a, b)
+
+        async def echo(from_peer, proto, data):
+            return b"echo:" + data
+
+        b.handle("/wf/echo", echo)
+
+        def from_a(src=None, **_ctx):
+            return src == a.peer_id
+
+        with faults.inject(
+            "net.transport.write", error=faults.Drop, match=from_a
+        ) as plan:
+            with pytest.raises(asyncio.TimeoutError):
+                await a.request(b.peer_id, "/wf/echo", b"hi")
+            assert plan.fired >= 1
+            assert a.frames_dropped >= 1
+        assert await a.request(b.peer_id, "/wf/echo", b"hi") == b"echo:hi"
+        net.close()
+
+    run(go())
+
+
+def test_read_fault_loses_inbound_frames_and_recovers():
+    """net.transport.read: receive-side loss is indistinguishable from a
+    lossy link — the request times out and the node stays healthy."""
+
+    async def go():
+        net = LoopbackNet()
+        a = net.register(MeshFabric("rf-a", request_timeout=0.3))
+        b = net.register(MeshFabric("rf-b"))
+        await net.connect(a, b)
+
+        async def echo(from_peer, proto, data):
+            return b"ok"
+
+        b.handle("/rf/echo", echo)
+
+        def into_b(dst=None, **_ctx):
+            return dst == b.peer_id
+
+        with faults.inject(
+            "net.transport.read", error=faults.Drop, match=into_b
+        ) as plan:
+            with pytest.raises(asyncio.TimeoutError):
+                await a.request(b.peer_id, "/rf/echo", b"")
+            assert plan.fired >= 1
+        assert await a.request(b.peer_id, "/rf/echo", b"") == b"ok"
+        net.close()
+
+    run(go())
+
+
+def test_gossip_publish_fault_surfaces_to_publisher():
+    """net.gossip.publish: an armed publish-side fault raises to the
+    caller before anything is serialized or counted."""
+
+    async def go():
+        swarm = await Swarm.create(2)
+        try:
+            node = swarm.nodes[0]
+            before = node.net.gossip.stats.published
+            with faults.inject("net.gossip.publish", times=1) as plan:
+                with pytest.raises(faults.FaultError):
+                    await node.net.gossip.publish(
+                        GossipType.voluntary_exit, None, None
+                    )
+                assert plan.fired == 1
+            assert node.net.gossip.stats.published == before
+        finally:
+            swarm.close()
+
+    run(go())
+
+
+def test_reqresp_request_fault_fails_then_delay_slows():
+    """net.reqresp.request: a client-side fault fails the request; a
+    Delay directive stalls it but lets it complete."""
+
+    async def go():
+        swarm = Swarm()
+        try:
+            client = swarm.add_node()
+            server = swarm.add_node()
+            await swarm.connect(client, server)
+            with faults.inject("net.reqresp.request", times=1) as plan:
+                with pytest.raises(faults.FaultError):
+                    await client.net.reqresp.request(server.peer_id, PING, 1)
+                assert await client.net.reqresp.request(
+                    server.peer_id, PING, 1
+                ) == [0]
+                assert plan.fired == 1 and plan.calls == 2
+            with faults.inject(
+                "net.reqresp.request", error=lambda: faults.Delay(0.01)
+            ) as slow:
+                assert await client.net.reqresp.request(
+                    server.peer_id, PING, 1
+                ) == [0]
+                assert slow.fired == 1
+        finally:
+            swarm.close()
+
+    run(go())
+
+
+def test_batch_download_fault_is_retried_and_sync_completes():
+    """sync.range.batch_download: one injected download failure takes
+    the scored-retry path and the chain still syncs to the target."""
+
+    async def go():
+        swarm = Swarm()
+        try:
+            server = swarm.add_node()
+            await swarm.advance(2 * E, import_into=[server])
+            lag = swarm.add_node()
+            await swarm.connect(lag, server)
+            rs = RangeSync(lag.net, lag.chain)
+            with faults.inject("sync.range.batch_download", times=1) as plan:
+                result = await rs.sync_until_synced()
+            assert plan.fired == 1
+            assert result.state == SyncState.Synced
+            assert lag.head_slot == 2 * E
+        finally:
+            swarm.close()
+
+    run(go())

@@ -24,6 +24,7 @@ from .core import Finding, ProjectRule, Rule, RULES, check_source, register, run
 from . import rules_async, rules_jax, rules_repo  # noqa: F401  (registration)
 from . import rules_interproc  # noqa: F401  (registration)
 from . import rules_program  # noqa: F401  (registration: v3 whole-program)
+from . import rules_bounds  # noqa: F401  (registration: v4 limbcheck + contracts)
 from . import callgraph, effects  # noqa: F401  (public: graph/effect API)
 
 __all__ = [

@@ -33,6 +33,7 @@ Two layers:
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -253,6 +254,227 @@ def walk_own(func: ast.AST) -> Iterable[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
+# -- v4 raw material: fault checkpoints + task lifecycle flows -------------
+
+# fire/inject receivers: `faults.fire("x")` / bare `fire("x")` when the name
+# was imported from a faults module (fault-coverage resolves the rest)
+_TASK_SPAWNS = {"create_task", "ensure_future"}
+# call names whose presence in a statement marks it as settling tasks
+_TASK_SETTLERS = {"gather", "wait", "wait_for", "shield", "as_completed"}
+# attr names that are machinery, never task containers
+_TASK_NOISE = {"cancel", "done", "discard", "add", "append", "pop",
+               "add_done_callback", "cancelled", "result", "exception"} | _TASK_SETTLERS
+
+
+def _fault_call_name(node: ast.Call, imports: Dict[str, str]) -> Optional[str]:
+    """'fire' / 'inject' when this call is a fault-checkpoint touch."""
+    dn = dotted_name(node.func) or ""
+    last = dn.rsplit(".", 1)[-1]
+    if last not in ("fire", "inject"):
+        return None
+    if dn in (last,):  # bare name: must be imported from a faults module
+        src = imports.get(last, "")
+        return last if src.rsplit(".", 1)[-1].startswith("fault") else None
+    # dotted: receiver chain must end in a `faults`-ish name
+    recv = dn.rsplit(".", 2)[-2] if "." in dn else ""
+    return last if recv.startswith("fault") else None
+
+
+def _task_flow(own: Sequence[ast.AST], imports: Dict[str, str],
+               str_env: Dict[str, str]):
+    """(task_binds, task_cancels, fault_fires, fault_injects) for one
+    function body.  Binds classify where a create_task/ensure_future
+    result lands (self attr / foreign attr / local); cancels are the attr
+    names this body settles (cancel/await/gather statements, with local
+    aliases like ``tasks = [t for t in self._tasks ...]`` expanded)."""
+    stmts = [n for n in own if isinstance(n, ast.stmt)]
+    # local name -> attr names its assigned expression mentions
+    aliases: Dict[str, Set[str]] = {}
+    for n in stmts:
+        if (
+            isinstance(n, ast.Assign)
+            and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Name)
+            and n.value is not None
+        ):
+            attrs = {
+                a.attr for a in ast.walk(n.value)
+                if isinstance(a, ast.Attribute) and a.attr not in _TASK_NOISE
+            }
+            if attrs:
+                aliases[n.targets[0].id] = attrs
+
+    def _is_settle_stmt(sub: Sequence[ast.AST]) -> bool:
+        for c in sub:
+            if isinstance(c, ast.Await):
+                return True
+            if isinstance(c, ast.Call):
+                if (
+                    isinstance(c.func, ast.Attribute)
+                    and c.func.attr == "cancel"
+                ):
+                    return True
+                dn = dotted_name(c.func) or ""
+                if dn.rsplit(".", 1)[-1] in _TASK_SETTLERS:
+                    return True
+        return False
+
+    cancels: Set[str] = set()
+    settle_names: Set[str] = set()  # local Names read inside settle stmts
+    for n in stmts:
+        sub = list(ast.walk(n))
+        if not _is_settle_stmt(sub):
+            continue
+        for c in sub:
+            if isinstance(c, ast.Attribute) and c.attr not in _TASK_NOISE:
+                cancels.add(c.attr)
+            elif isinstance(c, ast.Name):
+                settle_names.add(c.id)
+                cancels |= aliases.get(c.id, set())
+
+    fires: List[dict] = []
+    injects: List[dict] = []
+    binds: List[dict] = []
+    for node in own:
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _fault_call_name(node, imports)
+        if kind is not None and node.args:
+            rec = {
+                "name": _const_str(node.args[0], str_env),
+                "line": node.lineno,
+                "col": node.col_offset,
+                "expr": (unparse(node.args[0]) or "?")[:60],
+            }
+            (fires if kind == "fire" else injects).append(rec)
+            continue
+        dn = dotted_name(node.func) or ""
+        if dn.rsplit(".", 1)[-1] not in _TASK_SPAWNS:
+            continue
+        binds.append(_classify_task_bind(node, stmts, settle_names, aliases))
+    return binds, sorted(cancels), fires, injects
+
+
+def _classify_task_bind(call: ast.Call, stmts, settle_names, aliases) -> dict:
+    rec = {"kind": "local", "attr": None, "line": call.lineno,
+           "col": call.col_offset, "handled": False}
+
+    def _attr_kind(recv: ast.AST):
+        """(kind, attr) for a self.X / obj.X receiver chain, else None."""
+        if isinstance(recv, ast.Attribute):
+            base = recv.value
+            if isinstance(base, ast.Name):
+                kind = "self_attr" if base.id == "self" else "obj_attr"
+                return kind, recv.attr
+            return "obj_attr", recv.attr
+        return None
+
+    parent = getattr(call, "_ll_parent", None)
+    if isinstance(parent, ast.Await):
+        rec.update(kind="local", handled=True)
+        return rec
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        t = parent.targets[0]
+        if isinstance(t, ast.Attribute):
+            hit = _attr_kind(t)
+            if hit:
+                rec.update(kind=hit[0], attr=hit[1])
+                return rec
+        if isinstance(t, ast.Name):
+            return _classify_local_task(t.id, parent, stmts, settle_names,
+                                        aliases, rec)
+        rec.update(handled=True)  # tuple/subscript target: assume tracked
+        return rec
+    if (
+        isinstance(parent, ast.Call)
+        and isinstance(parent.func, ast.Attribute)
+        and parent.func.attr in ("add", "append")
+    ):
+        hit = _attr_kind(parent.func.value)
+        if hit:
+            rec.update(kind=hit[0], attr=hit[1])
+            return rec
+        rec.update(handled=True)
+        return rec
+    if isinstance(parent, ast.Expr):
+        # bare-statement discard is task-no-ref territory, not lifecycle
+        rec.update(handled=True)
+        return rec
+    # return / nested in gather(...) / passed along: ownership transferred
+    rec.update(handled=True)
+    return rec
+
+
+def _classify_local_task(name: str, bind_stmt, stmts, settle_names,
+                         aliases, rec: dict) -> dict:
+    """A locally-named task: stored into an attr collection reclassifies
+    the bind; a cancel/await/return use marks it handled; any other use
+    (beyond add_done_callback bookkeeping) transfers ownership."""
+    escaped = False
+    for n in stmts:
+        if n is bind_stmt:
+            continue
+        for c in ast.walk(n):
+            if not (isinstance(c, ast.Name) and c.id == name):
+                continue
+            p = getattr(c, "_ll_parent", None)
+            # self._tasks.add(task) / outer._tasks.append(task)
+            if (
+                isinstance(p, ast.Call)
+                and c in p.args
+                and isinstance(p.func, ast.Attribute)
+                and p.func.attr in ("add", "append")
+                and isinstance(p.func.value, ast.Attribute)
+                and isinstance(p.func.value.value, ast.Name)
+            ):
+                kind = ("self_attr" if p.func.value.value.id == "self"
+                        else "obj_attr")
+                rec.update(kind=kind, attr=p.func.value.attr)
+                return rec
+            if isinstance(p, ast.Attribute) and p.attr == "add_done_callback":
+                continue  # bookkeeping only
+            if (
+                isinstance(p, ast.Call)
+                and isinstance(p.func, ast.Attribute)
+                and p.func.attr == "add_done_callback"
+            ):
+                continue
+            escaped = True
+    # assignment into an attr / subscript target: self.X = task
+    for n in stmts:
+        if not isinstance(n, ast.Assign) or not isinstance(n.value, ast.Name):
+            continue
+        if n.value.id != name:
+            continue
+        for t in n.targets:
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+                kind = "self_attr" if t.value.id == "self" else "obj_attr"
+                rec.update(kind=kind, attr=t.attr)
+                return rec
+            if (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Attribute)
+                and isinstance(t.value.value, ast.Name)
+            ):
+                kind = ("self_attr" if t.value.value.id == "self"
+                        else "obj_attr")
+                rec.update(kind=kind, attr=t.value.attr)
+                return rec
+    if name in settle_names:
+        rec.update(handled=True)
+        return rec
+    for n in stmts:
+        if isinstance(n, ast.Return) and n.value is not None and any(
+            isinstance(c, ast.Name) and c.id == name
+            for c in ast.walk(n.value)
+        ):
+            rec.update(handled=True)
+            return rec
+    if escaped:
+        rec.update(handled=True)
+    return rec
+
+
 def _interface_marker(func: ast.AST) -> bool:
     """True when a stub body is spelled `...` or raise NotImplementedError
     — the idioms that mark an interface, unlike a plain `pass` stub."""
@@ -306,6 +528,9 @@ class _Extractor(ast.NodeVisitor):
         self.jit_wrappers: List[str] = []  # names bound to registry.jitted()
         self.metric_defs: List[dict] = []
         self.release_defs: List[str] = []  # stage-release method short names
+        # v4 whole-program raw material (fault-coverage / task-lifecycle)
+        self.fault_fires: List[dict] = []
+        self.fault_injects: List[dict] = []
 
     # -- imports ------------------------------------------------------
 
@@ -484,6 +709,11 @@ class _Extractor(ast.NodeVisitor):
         calls = self._collect_calls(own, canon, param_set, local_tags)
         metric_uses = self._collect_metric_uses(own)
         release_calls = self._collect_release_calls(node, own)
+        task_binds, task_cancels, fires, injects = _task_flow(
+            own, self.imports, str_env
+        )
+        self.fault_fires.extend(fires)
+        self.fault_injects.extend(injects)
         if "release" in node.name and any(
             isinstance(n, ast.Assign)
             and isinstance(n.value, ast.Constant)
@@ -516,6 +746,8 @@ class _Extractor(ast.NodeVisitor):
                 "width_locals": width_locals,
                 "metric_uses": metric_uses,
                 "release_calls": release_calls,
+                "task_binds": task_binds,
+                "task_cancels": task_cancels,
                 "calls": calls,
                 "effects": effects,
             }
@@ -798,6 +1030,22 @@ class _Extractor(ast.NodeVisitor):
         return out
 
 
+# modules whose full source rides in the summary so limb-bounds can
+# re-interpret their expression language from cached summaries alone
+_BOUNDS_MODULES = ("fp", "tower", "curve", "pairing", "pallas_fp", "limbs")
+
+
+def bounds_in_scope(path: str, text: str) -> bool:
+    """limb-bounds scope: the BLS12-381 kernel modules, plus any source
+    that opts in by carrying an ``@bounds:`` annotation (lint fixtures)."""
+    base = os.path.basename(path)
+    if "ops/bls12_381" in path.replace(os.sep, "/") and base in tuple(
+        m + ".py" for m in _BOUNDS_MODULES
+    ):
+        return True
+    return "@bounds:" in text
+
+
 def extract_summary(
     tree: ast.Module, text: str, path: str, suppressions=None
 ) -> dict:
@@ -821,6 +1069,9 @@ def extract_summary(
         "metric_defs": ex.metric_defs,
         "release_defs": sorted(set(ex.release_defs)),
         "functions": ex.functions,
+        "fault_fires": ex.fault_fires,
+        "fault_injects": ex.fault_injects,
+        "bounds_src": text if bounds_in_scope(path, text) else None,
         "suppress_lines": {str(k): sorted(v) for k, v in per_line.items()},
         "suppress_file": sorted(per_file),
     }

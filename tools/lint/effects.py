@@ -359,7 +359,9 @@ _CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".cache.j
 # v2: summaries grew the v3 whole-program raw material (call arg
 # provenance, width locals, metric defs/uses, release guards); a v1
 # cache must not feed the new rules empty fields
-_CACHE_VERSION = 2
+# v3: v4-rule raw material (fault_fires/fault_injects, task_binds/
+# task_cancels, bounds_src for the limb-bound interpreter)
+_CACHE_VERSION = 3
 
 
 def _lint_stamp() -> str:

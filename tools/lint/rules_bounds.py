@@ -1,0 +1,2437 @@
+"""Whole-program (v4) rules: limb-bound abstract interpretation + the
+fault-checkpoint and task-lifecycle contracts.
+
+* ``limb-bounds`` ("limbcheck") — an abstract interpreter over the
+  jax/numpy expression language of the BLS12-381 kernel modules
+  (ops/bls12_381/{fp,tower,curve,pairing,pallas_fp}.py).  Every value
+  carries an interval + dtype; limb tensors start canonical
+  ``[0, 2^LIMB_BITS - 1]`` in uint32, and each arithmetic result is
+  checked against 2^32.  An over/underflowing ``+``/``-``/``*`` does not
+  report immediately: mod-2^32 wraparound composes with ``& (2^k - 1)``
+  (the mask is a ring homomorphism onto mod 2^k), so the value is
+  *tainted* and only a taint-incompatible use — ``>>``, compare, sum,
+  return, select — reports, anchored at the original wrap site with the
+  interval derivation chain.  Function summaries close the analysis over
+  calls: ``@bounds:`` docstring annotations declare param/return
+  intervals (verified against the body, trusted at call sites);
+  unannotated in-scope callees are inlined with memoization.  Unprovable
+  sites (a strong uint32 operand meeting an untracked value) demand an
+  inline suppression with a reviewed reason, like v2 root suppression.
+
+  Domain assumptions (documented, checked nowhere else):
+  - reductions (``.sum(axis=k)``) are over limb axes of width <= NLIMBS;
+  - ``lax.scan`` trip counts are bounded by NLIMBS (limb scans are exact;
+    bit scans must converge, which they do in one step);
+  - decorators are interval-transparent (``_flat_leading``, ``cached``);
+  - ``dict.get`` on a module-level cache dict returns the joined stored
+    value (the ``None`` arm always refills before use).
+
+* ``fault-coverage`` — every ``faults.fire("name")`` literal under
+  lodestar_tpu/ must appear in a docs/FAULTS.md row (backtick-quoted)
+  and in at least one test's ``inject(...)`` plan.  A checkpoint nobody
+  can chaos-test is dead weight; an undocumented one is invisible to
+  operators.
+
+* ``task-lifecycle`` — every ``create_task``/``ensure_future`` result
+  must flow to a field/collection that some close()/stop()-reachable
+  path cancels or awaits (the leak class PR 15's heartbeat pruning fixed
+  by hand).  Locals must be cancelled/awaited/returned in-body.
+
+All three consume ModuleSummary raw material from tools/lint/callgraph.py
+(``bounds_src``, ``fault_fires``/``fault_injects``,
+``task_binds``/``task_cancels``) and ride the v3 summary cache.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ProjectRule, register, REPO_ROOT
+from .callgraph import dotted_name, unparse
+
+U32_MOD = 1 << 32
+_CHAIN_CAP = 6        # interval-provenance frames kept per value
+_INLINE_DEPTH = 12    # max in-scope call inlining depth
+_LOOP_CAP = 64        # fixpoint iterations before widening to unknown
+_UNROLL_CAP = 128     # max statically-unrolled python-range iterations
+
+
+# ---------------------------------------------------------------------------
+# abstract values
+# ---------------------------------------------------------------------------
+
+
+class _Unknown:
+    """Untracked value (host objects, out-of-scope calls, shapes)."""
+
+    def __repr__(self):
+        return "?"
+
+
+UNK = _Unknown()
+
+
+class _NoneVal:
+    def __repr__(self):
+        return "None"
+
+
+NONEV = _NoneVal()
+
+
+class Const:
+    """Known python scalar (int/bool/float/str) — keeps range()/shift
+    amounts/eye(k=...) precise."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __repr__(self):
+        return f"Const({self.v!r})"
+
+
+class Interval:
+    """[lo, hi] plus dtype.  ``weak`` marks bare int literals (jax
+    weak-typed scalars): they never make a mixed expression "unprovable"
+    and adopt the strong side's dtype."""
+
+    __slots__ = ("lo", "hi", "dtype", "weak", "prov")
+
+    def __init__(self, lo, hi, dtype="u32", weak=False, prov=()):
+        self.lo = lo
+        self.hi = hi
+        self.dtype = dtype
+        self.weak = weak
+        self.prov = tuple(prov)[-_CHAIN_CAP:]
+
+    def __repr__(self):
+        return f"[{self.lo}, {self.hi}]({self.dtype})"
+
+
+class Wrapped:
+    """Taint: a u32 expression whose interval crossed 2^32 (or went
+    negative).  ``+ - *`` propagate silently; ``& (2^k - 1)`` forgives
+    (ring homomorphism); everything else reports at the wrap site."""
+
+    __slots__ = ("line", "col", "expr", "chain", "note")
+
+    def __init__(self, line, col, expr, chain, note):
+        self.line = line
+        self.col = col
+        self.expr = expr
+        self.chain = tuple(chain)[-_CHAIN_CAP:]
+        self.note = note
+
+    def __repr__(self):
+        return f"Wrapped@{self.line}"
+
+
+class Mat:
+    """A 0/1 constant matrix (np.eye family): entry and column-sum caps."""
+
+    __slots__ = ("max_entry", "max_colsum")
+
+    def __init__(self, max_entry=1, max_colsum=1):
+        self.max_entry = max_entry
+        self.max_colsum = max_colsum
+
+
+class MatProd:
+    """``x[..., :, None] * M`` pending a ``.sum(axis=-2)`` contraction:
+    the sum is bounded by x.hi * colsum, not x.hi * NLIMBS."""
+
+    __slots__ = ("iv", "colsum")
+
+    def __init__(self, iv: Interval, colsum: int):
+        self.iv = iv
+        self.colsum = colsum
+
+
+class Tup:
+    """Python tuple/list; ``exact`` False for comprehension results of
+    unknown length (items then holds the single joined element)."""
+
+    __slots__ = ("items", "exact")
+
+    def __init__(self, items, exact=True):
+        self.items = list(items)
+        self.exact = exact
+
+
+class DictVal:
+    __slots__ = ("val",)
+
+    def __init__(self, val):
+        self.val = val
+
+
+class FuncRef:
+    __slots__ = ("ma", "node", "env")
+
+    def __init__(self, ma, node, env):
+        self.ma = ma          # defining ModuleAnalysis
+        self.node = node      # FunctionDef / Lambda
+        self.env = env        # defining (closure) env dict
+
+
+class ModRef:
+    __slots__ = ("ma",)
+
+    def __init__(self, ma):
+        self.ma = ma
+
+
+class NsRef:
+    """Dotted path into an opaque-but-modeled namespace (jnp/np/jax/...)."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self, parts):
+        self.parts = tuple(parts)
+
+
+class DTypeRef:
+    __slots__ = ("dtype",)
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+
+
+class MethodRef:
+    __slots__ = ("recv", "name")
+
+    def __init__(self, recv, name):
+        self.recv = recv
+        self.name = name
+
+
+class AtView:
+    """``x.at[...]`` pending .set/.add."""
+
+    __slots__ = ("base",)
+
+    def __init__(self, base):
+        self.base = base
+
+
+_NS_DTYPES = {
+    "uint32": "u32",
+    "int32": "i32",
+    "int64": "i64",
+    "float32": "f32",
+    "float64": "f64",
+    "bool_": "bool",
+}
+
+
+# ---------------------------------------------------------------------------
+# @bounds: docstring annotations
+# ---------------------------------------------------------------------------
+
+_BVAL_RE = re.compile(r"^(?:2\^(\d+)(?:\s*([+-])\s*(\d+))?|(\d+)|([A-Za-z_]\w*))$")
+
+
+def _bounds_value(tok: str, consts: Dict[str, int]) -> Optional[int]:
+    m = _BVAL_RE.match(tok.strip())
+    if not m:
+        return None
+    if m.group(1) is not None:
+        v = 1 << int(m.group(1))
+        if m.group(2):
+            k = int(m.group(3))
+            v = v + k if m.group(2) == "+" else v - k
+        return v
+    if m.group(4) is not None:
+        return int(m.group(4))
+    return consts.get(m.group(5))
+
+
+def parse_bounds_annotation(doc: Optional[str], consts: Dict[str, int]):
+    """First ``@bounds:`` line of a docstring ->
+    {"params": {name: (lo, hi) | "host"}, "ret": (lo, hi) | "host" | None}
+    or None (no annotation / syntax error -> treated as unannotated)."""
+    if not doc or "@bounds:" not in doc:
+        return None
+    line = None
+    for ln in doc.splitlines():
+        ln = ln.strip()
+        if ln.startswith("@bounds:"):
+            line = ln[len("@bounds:"):].strip()
+            break
+    if line is None:
+        return None
+    if "->" in line:
+        left, _, right = line.partition("->")
+    else:
+        left, right = line, ""
+    out = {"params": {}, "ret": None}
+
+    def _spec(txt: str):
+        txt = txt.strip()
+        if txt == "host":
+            return "host"
+        m = re.match(r"^\[([^,\]]+),([^\]]+)\]$", txt)
+        if not m:
+            return None
+        lo = _bounds_value(m.group(1), consts)
+        hi = _bounds_value(m.group(2), consts)
+        if lo is None or hi is None:
+            return None
+        return (lo, hi)
+
+    left = left.strip()
+    if left:
+        # split on commas not inside brackets
+        depth, buf, parts = 0, "", []
+        for ch in left:
+            if ch == "[":
+                depth += 1
+            elif ch == "]":
+                depth -= 1
+            if ch == "," and depth == 0:
+                parts.append(buf)
+                buf = ""
+            else:
+                buf += ch
+        if buf.strip():
+            parts.append(buf)
+        for p in parts:
+            p = p.strip()
+            m = re.match(r"^([A-Za-z_]\w*)\s+(.*)$", p)
+            if not m:
+                return None
+            spec = _spec(m.group(2))
+            if spec is None:
+                return None
+            out["params"][m.group(1)] = spec
+    if right.strip():
+        spec = _spec(right)
+        if spec is None:
+            return None
+        out["ret"] = spec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# join / order
+# ---------------------------------------------------------------------------
+
+
+def _join(a, b):
+    if a is b:
+        return a
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if isinstance(a, Wrapped):
+        return a
+    if isinstance(b, Wrapped):
+        return b
+    if a is UNK or b is UNK:
+        return UNK
+    # NONEV is absorbed: optionality is handled by `is None` narrowing
+    if a is NONEV:
+        return b
+    if b is NONEV:
+        return a
+    if isinstance(a, Const) and isinstance(b, Const):
+        if a.v == b.v:
+            return a
+        if isinstance(a.v, (int, float)) and isinstance(b.v, (int, float)):
+            return Interval(min(a.v, b.v), max(a.v, b.v), "host", weak=True)
+        return UNK
+    ia, ib = _as_interval(a), _as_interval(b)
+    if isinstance(ia, Interval) and isinstance(ib, Interval):
+        dt = _join_dtype(ia, ib)
+        if dt is None:
+            return UNK
+        return Interval(
+            min(ia.lo, ib.lo), max(ia.hi, ib.hi), dt,
+            weak=ia.weak and ib.weak, prov=ia.prov or ib.prov,
+        )
+    if isinstance(a, Tup) and isinstance(b, Tup):
+        if a.exact and b.exact and len(a.items) == len(b.items):
+            return Tup([_join(x, y) for x, y in zip(a.items, b.items)])
+        ja = _join_all(a.items)
+        jb = _join_all(b.items)
+        return Tup([_join(ja, jb)], exact=False)
+    if isinstance(a, Mat) and isinstance(b, Mat):
+        return Mat(max(a.max_entry, b.max_entry), max(a.max_colsum, b.max_colsum))
+    if isinstance(a, FuncRef) and isinstance(b, FuncRef) and a.node is b.node:
+        return a
+    return UNK
+
+
+def _join_all(vals):
+    out = None
+    for v in vals:
+        out = v if out is None else _join(out, v)
+    return out if out is not None else UNK
+
+
+def _join_dtype(a: Interval, b: Interval) -> Optional[str]:
+    if a.dtype == b.dtype:
+        return a.dtype
+    if a.weak:
+        return b.dtype
+    if b.weak:
+        return a.dtype
+    if {a.dtype, b.dtype} <= {"u32", "host", "i32", "i64"}:
+        return "u32" if "u32" in (a.dtype, b.dtype) else a.dtype
+    return None
+
+
+def _as_interval(v):
+    """Degrade a value to an Interval where possible (for joins/sums)."""
+    if isinstance(v, Interval):
+        return v
+    if isinstance(v, Const):
+        if isinstance(v.v, bool):
+            return Interval(int(v.v), int(v.v), "bool", weak=True)
+        if isinstance(v.v, int):
+            return Interval(v.v, v.v, "host", weak=True)
+        if isinstance(v.v, float):
+            return Interval(v.v, v.v, "f32", weak=True)
+        return UNK
+    if isinstance(v, MatProd):
+        return Interval(0, v.iv.hi * 1, v.iv.dtype, prov=v.iv.prov)
+    if isinstance(v, Mat):
+        return Interval(0, v.max_entry, "u32")
+    return v
+
+
+def _leq(a, b) -> bool:
+    """a below-or-equal b in the join order (fixpoint convergence)."""
+    if b is UNK or a is b:
+        return True
+    if isinstance(a, Wrapped):
+        return isinstance(b, Wrapped)
+    if isinstance(b, Wrapped):
+        return True
+    ia, ib = _as_interval(a), _as_interval(b)
+    if isinstance(ia, Interval) and isinstance(ib, Interval):
+        return ia.lo >= ib.lo and ia.hi <= ib.hi
+    if isinstance(a, Tup) and isinstance(b, Tup):
+        if a.exact and b.exact and len(a.items) == len(b.items):
+            return all(_leq(x, y) for x, y in zip(a.items, b.items))
+        return _leq(_join_all(a.items), _join_all(b.items))
+    if isinstance(a, Const) and isinstance(b, Const):
+        return a.v == b.v
+    return False
+
+
+def _is_pow2_mask(c: int) -> bool:
+    return c >= 0 and (c + 1) & c == 0
+
+
+def _bitlen_bound(hi) -> int:
+    try:
+        return (1 << int(hi).bit_length()) - 1
+    except (TypeError, ValueError, OverflowError):
+        return U32_MOD - 1
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+
+class ModuleAnalysis:
+    """Parsed in-scope module: its AST, top-level function defs, parsed
+    @bounds annotations, and (after ``_Interp.module_env``) the
+    module-level abstract environment."""
+
+    def __init__(self, summary: dict):
+        self.path: str = summary["path"]
+        self.module: str = summary["module"]
+        self.src: str = summary["bounds_src"]
+        self.imports: Dict[str, str] = summary.get("imports", {})
+        self.tree = ast.parse(self.src)
+        self.funcs: Dict[str, ast.AST] = {}
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[node.name] = node
+        self.env: Optional[dict] = None  # module env, set lazily
+        self.annots: Dict[str, dict] = {}
+        # module dict consts: name -> joined value of every `NAME[k] = v`
+        # assignment anywhere in the module (the _SHIFT_CACHE pattern)
+        self.dict_stores: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                    ):
+                        self.dict_stores.add(t.value.id)
+
+    def int_consts(self) -> Dict[str, int]:
+        out = {}
+        for k, v in (self.env or {}).items():
+            if isinstance(v, Const) and isinstance(v.v, int):
+                out[k] = v.v
+        return out
+
+
+class _Return(Exception):
+    pass  # never raised; Return handled via signals
+
+
+class _Interp:
+    """One limb-bounds run over a project's in-scope modules."""
+
+    def __init__(self, analyses: Dict[str, ModuleAnalysis]):
+        self.analyses = analyses          # module name -> ModuleAnalysis
+        self.findings: Dict[tuple, Finding] = {}
+        self.report_on = True
+        self.memo: Dict[tuple, tuple] = {}   # call memo -> (ret, findings)
+        self.call_stack: List[tuple] = []
+        self.ma: Optional[ModuleAnalysis] = None  # current module
+        self.ret_sites: List[tuple] = []  # (value, node) of current run
+        # canonical limb facts, refreshed per module sweep
+        self.limb_bits = 13
+        self.nlimbs = 30
+
+    # -- findings ------------------------------------------------------
+
+    def report(self, node, message, chain=(), effects=("overflow",)):
+        if not self.report_on:
+            return
+        path = self.ma.path
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        key = (path, line, col, message[:80])
+        if key in self.findings:
+            return
+        self.findings[key] = Finding(
+            path=path, line=line, col=col, rule="limb-bounds",
+            message=message, effects=tuple(effects),
+            chain=tuple(chain)[-_CHAIN_CAP:],
+        )
+
+    def _frame(self, node, lo, hi, dtype) -> str:
+        src = (unparse(node) or "?")[:48]
+        return f"{self.ma.path}:{getattr(node, 'lineno', 0)} {src} -> [{lo}, {hi}] ({dtype})"
+
+    def report_wrapped_use(self, w: Wrapped, node, use: str):
+        self.report(
+            _Loc(w.line, w.col),
+            f"uint32 expression {w.expr!r} {w.note}; the wrapped value is "
+            f"then {use} at line {getattr(node, 'lineno', '?')} — wraparound "
+            "does not commute with that use (mask it with & (2^k-1) first, "
+            "or tighten the bound)",
+            chain=w.chain,
+        )
+
+    # -- canonical facts ----------------------------------------------
+
+    def _refresh_limb_facts(self, env: dict):
+        lb = env.get("LIMB_BITS")
+        nl = env.get("NLIMBS")
+        if isinstance(lb, Const) and isinstance(lb.v, int):
+            self.limb_bits = lb.v
+        if isinstance(nl, Const) and isinstance(nl.v, int):
+            self.nlimbs = nl.v
+
+    def canonical(self) -> Interval:
+        return Interval(0, (1 << self.limb_bits) - 1, "u32")
+
+    # -- module env ----------------------------------------------------
+
+    def module_env(self, name: str) -> dict:
+        ma = self.analyses[name]
+        if ma.env is not None:
+            return ma.env
+        ma.env = {}
+        prev, self.ma = self.ma, ma
+        prev_rep, self.report_on = self.report_on, False
+        try:
+            self.exec_block(ma.tree.body, ma.env)
+        finally:
+            self.ma = prev
+            self.report_on = prev_rep
+        # parse annotations now that consts are known
+        consts = ma.int_consts()
+        # pull limb consts from an imported limbs module if absent locally
+        for alias, target in ma.imports.items():
+            if target in self.analyses and alias not in ma.env:
+                pass
+        for fname, fnode in ma.funcs.items():
+            ann = parse_bounds_annotation(ast.get_docstring(fnode), consts)
+            if ann is not None:
+                ma.annots[fname] = ann
+        return ma.env
+
+    # -- function runs -------------------------------------------------
+
+    def seed_params(self, ma: ModuleAnalysis, fnode, args=None, kwargs=None):
+        """Bind params: @bounds declarations > python type annotations
+        (-> host unknown) > literal defaults > canonical limbs."""
+        ann = ma.annots.get(fnode.name, {"params": {}, "ret": None})
+        a = fnode.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        kw_names = [p.arg for p in a.kwonlyargs]
+        env_args: Dict[str, object] = {}
+        if args is not None:
+            for i, v in enumerate(args):
+                if i < len(names):
+                    env_args[names[i]] = v
+                elif a.vararg is not None:
+                    env_args.setdefault(a.vararg.arg, Tup([], exact=False))
+        if kwargs:
+            env_args.update(kwargs)
+        defaults: Dict[str, object] = {}
+        pos = a.posonlyargs + a.args
+        for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            defaults[p.arg] = d
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                defaults[p.arg] = d
+        out = {}
+        for p in pos + a.kwonlyargs:
+            pname = p.arg
+            spec = ann["params"].get(pname)
+            if pname in env_args:
+                v = env_args[pname]
+                # a declared host param stays whatever the caller passed
+                out[pname] = v
+                continue
+            if spec == "host":
+                out[pname] = UNK
+            elif isinstance(spec, tuple):
+                out[pname] = Interval(spec[0], spec[1], "u32")
+            elif _host_annotation(p.annotation):
+                out[pname] = UNK
+            elif pname in defaults:
+                out[pname] = self._literal_default(defaults[pname])
+            elif pname in ("self", "cls"):
+                out[pname] = UNK
+            else:
+                out[pname] = self.canonical()
+        if a.vararg is not None and a.vararg.arg not in out:
+            out[a.vararg.arg] = Tup([], exact=False)
+        if a.kwarg is not None:
+            out[a.kwarg.arg] = UNK
+        return out
+
+    def _literal_default(self, d):
+        if isinstance(d, ast.Constant):
+            if d.value is None:
+                return NONEV
+            if isinstance(d.value, (int, bool, float, str)):
+                return Const(d.value)
+        if isinstance(d, ast.UnaryOp) and isinstance(d.op, ast.USub) and \
+                isinstance(d.operand, ast.Constant) and \
+                isinstance(d.operand.value, (int, float)):
+            return Const(-d.operand.value)
+        return UNK
+
+    def run_function(self, ma: ModuleAnalysis, fnode, args=None, kwargs=None,
+                     closure_env=None):
+        """Interpret one function body; returns the joined return value.
+        Findings go to self.findings (subject to report_on)."""
+        menv = self.module_env(ma.module)
+        env = dict(menv)
+        if closure_env:
+            env.update(closure_env)
+        env.update(self.seed_params(ma, fnode, args, kwargs))
+        prev_ma, self.ma = self.ma, ma
+        prev_ret, self.ret_sites = self.ret_sites, []
+        self._refresh_limb_facts(env)
+        try:
+            self.exec_block(fnode.body, env)
+            rets = self.ret_sites
+            ann = ma.annots.get(getattr(fnode, "name", ""), None)
+            if ann and isinstance(ann.get("ret"), tuple):
+                lo, hi = ann["ret"]
+                for val, rnode in rets:
+                    self._check_declared_return(val, rnode, fnode.name, lo, hi)
+            out = _join_all([v for v, _ in rets]) if rets else NONEV
+        finally:
+            self.ma = prev_ma
+            self.ret_sites = prev_ret
+            if self.ma is not None and self.ma.env is not None:
+                self._refresh_limb_facts(self.ma.env)
+        return out
+
+    def _check_declared_return(self, val, rnode, fname, lo, hi):
+        for leaf in _leaves(val):
+            if isinstance(leaf, Wrapped):
+                self.report_wrapped_use(leaf, rnode, "returned")
+            elif isinstance(leaf, Interval) and leaf.dtype == "u32" \
+                    and not leaf.weak and (leaf.hi > hi or leaf.lo < lo):
+                self.report(
+                    rnode,
+                    f"{fname} returns [{leaf.lo}, {leaf.hi}] exceeding its "
+                    f"declared @bounds return [{lo}, {hi}]",
+                    chain=leaf.prov, effects=("annotation-violated",),
+                )
+
+    # -- calls ---------------------------------------------------------
+
+    def call_function(self, fref: FuncRef, args, kwargs, node):
+        ma, fnode = fref.ma, fref.node
+        if isinstance(fnode, ast.Lambda):
+            env = dict(fref.env)
+            a = fnode.args
+            names = [p.arg for p in a.posonlyargs + a.args]
+            for i, v in enumerate(args):
+                if i < len(names):
+                    env[names[i]] = v
+            for p in names[len(args):]:
+                env[p] = UNK
+            env.update(kwargs or {})
+            prev_ma, self.ma = self.ma, ma
+            try:
+                return self.eval(fnode.body, env)
+            finally:
+                self.ma = prev_ma
+        fname = fnode.name
+        self.module_env(ma.module)
+        ann = ma.annots.get(fname)
+        if ann is not None:
+            return self._call_annotated(ma, fnode, ann, args, kwargs, node)
+        key = (ma.module, fname)
+        if key in self.call_stack or len(self.call_stack) >= _INLINE_DEPTH:
+            return UNK
+        # report_on is part of the key: a run with reporting suppressed
+        # records no findings, and replaying it later with reporting on
+        # would silently drop them
+        sig = (self.report_on, ma.module, fname, _sig(args),
+               _sig(sorted((kwargs or {}).items())))
+        try:
+            hash(sig)
+        except TypeError:
+            sig = None
+        if sig is not None and sig in self.memo:
+            ret, found = self.memo[sig]
+            if self.report_on:
+                for f in found:
+                    self.findings.setdefault(f[0], f[1])
+            return ret
+        self.call_stack.append(key)
+        before = set(self.findings)
+        try:
+            ret = self.run_function(ma, fnode, args, kwargs,
+                                    closure_env=fref.env if fref.env else None)
+        finally:
+            self.call_stack.pop()
+        if sig is not None:
+            new = [(k, self.findings[k]) for k in self.findings if k not in before]
+            self.memo[sig] = (ret, new)
+        return ret
+
+    def _call_annotated(self, ma, fnode, ann, args, kwargs, node):
+        a = fnode.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        for i, v in enumerate(args):
+            if i >= len(names):
+                break
+            spec = ann["params"].get(names[i])
+            self._check_arg(v, spec, names[i], fnode.name, node)
+        for k, v in (kwargs or {}).items():
+            self._check_arg(v, ann["params"].get(k), k, fnode.name, node)
+        ret = ann.get("ret")
+        if isinstance(ret, tuple):
+            return Interval(ret[0], ret[1], "u32",
+                            prov=(self._frame(node, ret[0], ret[1], "u32"),))
+        return UNK
+
+    def _check_arg(self, v, spec, pname, fname, node):
+        if isinstance(v, Wrapped):
+            self.report_wrapped_use(v, node, f"passed to {fname}({pname}=...)")
+            return
+        if spec == "host" or spec is None:
+            if spec is None and isinstance(v, Interval) and v.dtype == "u32" \
+                    and not v.weak:
+                lo, hi = 0, (1 << self.limb_bits) - 1
+                if v.hi > hi or v.lo < lo:
+                    self.report(
+                        node,
+                        f"argument {pname!r} of {fname} is [{v.lo}, {v.hi}] "
+                        f"but {fname}'s @bounds declares canonical "
+                        f"[{lo}, {hi}] for undeclared params",
+                        chain=v.prov, effects=("annotation-violated",),
+                    )
+            return
+        lo, hi = spec
+        if isinstance(v, Interval) and v.dtype == "u32" and not v.weak and \
+                (v.hi > hi or v.lo < lo):
+            self.report(
+                node,
+                f"argument {pname!r} of {fname} is [{v.lo}, {v.hi}] outside "
+                f"its declared @bounds [{lo}, {hi}]",
+                chain=v.prov, effects=("annotation-violated",),
+            )
+
+
+class _Loc:
+    """Bare line/col anchor for findings at non-current nodes."""
+
+    def __init__(self, line, col):
+        self.lineno = line
+        self.col_offset = col
+
+
+def _leaves(v):
+    if isinstance(v, Tup):
+        for x in v.items:
+            yield from _leaves(x)
+    elif v is not None:
+        yield v
+
+
+def _sig(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_sig(x) for x in v)
+    if isinstance(v, Interval):
+        return ("iv", v.lo, v.hi, v.dtype, v.weak)
+    if isinstance(v, Const):
+        return ("c", v.v)
+    if isinstance(v, Wrapped):
+        return ("w", v.line, v.col)
+    if isinstance(v, Mat):
+        return ("m", v.max_entry, v.max_colsum)
+    if isinstance(v, MatProd):
+        return ("mp", _sig(v.iv), v.colsum)
+    if isinstance(v, Tup):
+        return ("t", v.exact, tuple(_sig(x) for x in v.items))
+    if v is NONEV:
+        return "none"
+    if isinstance(v, FuncRef):
+        return ("f", v.ma.module, getattr(v.node, "name", id(v.node)))
+    if isinstance(v, str):
+        return v
+    return "?"
+
+
+def _host_annotation(ann) -> bool:
+    if ann is None:
+        return False
+    name = ""
+    if isinstance(ann, ast.Name):
+        name = ann.id
+    elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value
+    elif isinstance(ann, ast.Subscript):
+        return _host_annotation(ann.value)
+    elif isinstance(ann, ast.Attribute):
+        name = ann.attr
+    return name in (
+        "int", "bool", "str", "float", "bytes", "Optional", "Callable",
+        "List", "Dict", "Tuple", "Sequence", "Iterable", "list", "dict",
+        "tuple", "object", "Any",
+    )
+
+
+# ---------------------------------------------------------------------------
+# statement execution (mixed into _Interp)
+# ---------------------------------------------------------------------------
+
+
+class _Signal:
+    def __init__(self, kind):
+        self.kind = kind  # "return" | "break" | "continue" | "raise"
+
+
+def _exec_block(self, body, env):
+    for stmt in body:
+        sig = self.exec_stmt(stmt, env)
+        if sig is not None:
+            return sig
+    return None
+
+
+def _exec_stmt(self, node, env):
+    if isinstance(node, ast.Expr):
+        self.eval(node.value, env)
+        return None
+    if isinstance(node, ast.Assign):
+        val = self.eval(node.value, env)
+        for t in node.targets:
+            self.assign(t, val, env)
+        return None
+    if isinstance(node, ast.AugAssign):
+        cur = self.eval(node.target, env)
+        val = self.eval(node.value, env)
+        res = self.binop(node, node.op, cur, val, env)
+        self.assign(node.target, res, env)
+        return None
+    if isinstance(node, ast.AnnAssign):
+        if node.value is not None:
+            self.assign(node.target, self.eval(node.value, env), env)
+        return None
+    if isinstance(node, ast.Return):
+        val = self.eval(node.value, env) if node.value is not None else NONEV
+        for leaf in _leaves(val):
+            if isinstance(leaf, Wrapped):
+                self.report_wrapped_use(leaf, node, "returned")
+        self.ret_sites.append((val, node))
+        return _Signal("return")
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        env[node.name] = FuncRef(self.ma, node, env)
+        return None
+    if isinstance(node, ast.If):
+        return self.exec_if(node, env)
+    if isinstance(node, ast.For):
+        return self.exec_for(node, env)
+    if isinstance(node, ast.While):
+        return self.exec_while(node, env)
+    if isinstance(node, ast.Break):
+        return _Signal("break")
+    if isinstance(node, ast.Continue):
+        return _Signal("continue")
+    if isinstance(node, ast.Raise):
+        return _Signal("raise")
+    if isinstance(node, (ast.Import, ast.ImportFrom)):
+        self.exec_import(node, env)
+        return None
+    if isinstance(node, ast.With):
+        for item in node.items:
+            v = self.eval(item.context_expr, env)
+            if item.optional_vars is not None:
+                self.assign(item.optional_vars, v, env)
+        return self.exec_block(node.body, env)
+    if isinstance(node, ast.Try):
+        base = dict(env)
+        sig = self.exec_block(node.body, env)
+        for h in node.handlers:
+            henv = dict(base)
+            hsig = self.exec_block(h.body, henv)
+            _join_env_into(env, henv)
+            if sig is not None and sig.kind == "raise":
+                sig = hsig
+        fsig = self.exec_block(node.finalbody, env)
+        return fsig or sig
+    if isinstance(node, (ast.Pass, ast.Assert, ast.Delete, ast.Global,
+                         ast.Nonlocal, ast.ClassDef)):
+        return None
+    return None
+
+
+def _assign(self, target, val, env):
+    if isinstance(target, ast.Name):
+        env[target.id] = val
+        return
+    if isinstance(target, (ast.Tuple, ast.List)):
+        elts = target.elts
+        if isinstance(val, Tup) and val.exact and len(val.items) == len(elts):
+            for t, v in zip(elts, val.items):
+                self.assign(t, v, env)
+        else:
+            piece = _join_all(val.items) if isinstance(val, Tup) else (
+                val if isinstance(val, (Interval, Wrapped)) else UNK)
+            for t in elts:
+                if isinstance(t, ast.Starred):
+                    self.assign(t.value, UNK, env)
+                else:
+                    self.assign(t, piece, env)
+        return
+    if isinstance(target, ast.Subscript):
+        # D[k] = v on a tracked container: join into the stored value
+        base = target.value
+        if isinstance(base, ast.Name):
+            cur = env.get(base.id)
+            if isinstance(cur, DictVal):
+                cur.val = _join(cur.val, val)
+            elif isinstance(cur, (Interval, Wrapped)):
+                env[base.id] = _join(cur, val)
+            elif isinstance(cur, Tup):
+                cur.items = [_join(_join_all(cur.items), val)]
+                cur.exact = False
+        return
+    if isinstance(target, ast.Starred):
+        self.assign(target.value, val, env)
+        return
+    # attribute targets (self.x = ...) — out of the kernel idiom, drop
+
+
+def _truthiness(self, v):
+    """True/False when statically known, else None."""
+    if isinstance(v, Const):
+        return bool(v.v)
+    if v is NONEV:
+        return False
+    if isinstance(v, Interval) and v.lo == v.hi and isinstance(v.lo, int):
+        return bool(v.lo)
+    return None
+
+
+def _exec_if(self, node, env):
+    cond = self.eval(node.test, env)
+    for leaf in _leaves(cond):
+        if isinstance(leaf, Wrapped):
+            self.report_wrapped_use(leaf, node.test, "branched on")
+    t = self._truthiness(cond)
+    if t is True:
+        return self.exec_block(node.body, env)
+    if t is False:
+        return self.exec_block(node.orelse, env)
+    env_t = dict(env)
+    env_f = dict(env)
+    sig_t = self.exec_block(node.body, env_t)
+    sig_f = self.exec_block(node.orelse, env_f)
+    ended_t = sig_t is not None
+    ended_f = sig_f is not None
+    if ended_t and ended_f:
+        env.clear()
+        env.update(env_t)
+        _join_env_into(env, env_f)
+        return sig_t if sig_t.kind == sig_f.kind else _Signal("return")
+    if ended_t:
+        env.clear()
+        env.update(env_f)
+        return None
+    if ended_f:
+        env.clear()
+        env.update(env_t)
+        return None
+    env.clear()
+    env.update(env_t)
+    _join_env_into(env, env_f)
+    return None
+
+
+def _iter_values(self, it):
+    """Concrete iteration domain for a for-loop, or None (fixpoint)."""
+    if isinstance(it, Tup) and it.exact and len(it.items) <= _UNROLL_CAP:
+        return it.items
+    if isinstance(it, Const) and isinstance(it.v, range):
+        if len(it.v) <= _UNROLL_CAP:
+            return [Const(i) for i in it.v]
+    return None
+
+
+def _exec_for(self, node, env):
+    it = self.eval(node.iter, env)
+    vals = self._iter_values(it)
+    if vals is not None:
+        for v in vals:
+            self.assign(node.target, v, env)
+            sig = self.exec_block(node.body, env)
+            if sig is not None:
+                if sig.kind == "break":
+                    return None
+                if sig.kind == "continue":
+                    continue
+                return sig
+        self.exec_block(node.orelse, env)
+        return None
+    # abstract element
+    if isinstance(it, Tup):
+        elem = _join_all(it.items) if it.items else UNK
+    elif isinstance(it, Interval):
+        elem = it
+    elif isinstance(it, DictVal):
+        elem = UNK
+    else:
+        elem = UNK
+    return self._fixpoint_loop(node, env, lambda e: self.assign(node.target, elem, e))
+
+
+def _exec_while(self, node, env):
+    self.eval(node.test, env)
+    return self._fixpoint_loop(node, env, None)
+
+
+def _fixpoint_loop(self, node, env, seed):
+    """Join-fixpoint over a loop body with unknown trip count.  Findings
+    are suppressed while iterating; the body runs once more on the final
+    join with reporting enabled."""
+    prev_rep, self.report_on = self.report_on, False
+    try:
+        for _ in range(_LOOP_CAP):
+            before = dict(env)
+            if seed:
+                seed(env)
+            sig = self.exec_block(node.body, env)
+            _join_env_into(env, before)
+            if sig is not None and sig.kind in ("return", "raise"):
+                # a loop that can only exit via return: stop iterating
+                pass
+            if all(_leq(env[k], before.get(k, env[k])) for k in env
+                   if k in before):
+                converged = True
+                break
+        else:
+            converged = False
+        if not converged:
+            # widen only the names that failed to stabilize (a diverging
+            # loop counter must not drag converged carry tensors to
+            # unknown with it)
+            for k in list(env):
+                if k in before and not _leq(env[k], before[k]):
+                    env[k] = UNK
+    finally:
+        self.report_on = prev_rep
+    if seed:
+        seed(env)
+    sig = self.exec_block(node.body, env)
+    if sig is not None and sig.kind in ("return", "raise"):
+        return None  # loop may also exit normally; fall through
+    self.exec_block(node.orelse, env)
+    return None
+
+
+def _exec_import(self, node, env):
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            env[name] = self._namespace_for(alias.name)
+        return
+    # ImportFrom: resolve via the summary's import map when possible
+    for alias in node.names:
+        local = alias.asname or alias.name
+        target = self.ma.imports.get(local)
+        if target is None:
+            mod = node.module or ""
+            target = f"{mod}.{alias.name}" if mod else alias.name
+        v = self._resolve_absolute(target)
+        env[local] = v
+
+
+def _namespace_for(self, dotted: str):
+    head = dotted.split(".")[0]
+    if head in ("jax", "numpy", "functools", "os", "math"):
+        return NsRef(dotted.split("."))
+    if dotted in self.analyses:
+        return ModRef(self.analyses[dotted])
+    return UNK
+
+
+def _resolve_absolute(self, target: str):
+    """Absolute dotted name -> abstract value (in-scope module member,
+    in-scope module itself, or a modeled/opaque namespace)."""
+    if target in self.analyses:
+        return ModRef(self.analyses[target])
+    mod, _, member = target.rpartition(".")
+    if mod in self.analyses:
+        menv = self.module_env(mod)
+        if member in menv:
+            return menv[member]
+        return UNK
+    head = target.split(".")[0]
+    if head in ("jax", "numpy", "jnp", "np", "functools", "math"):
+        last = target.rsplit(".", 1)[-1]
+        if last in _NS_DTYPES:
+            return DTypeRef(_NS_DTYPES[last])
+        return NsRef(target.split("."))
+    return UNK
+
+
+def _join_env_into(env, other):
+    for k in list(env):
+        if k in other:
+            env[k] = _join(env[k], other[k])
+    for k, v in other.items():
+        if k not in env:
+            env[k] = v
+
+
+_Interp.exec_block = _exec_block
+_Interp.exec_stmt = _exec_stmt
+_Interp.assign = _assign
+_Interp._truthiness = _truthiness
+_Interp.exec_if = _exec_if
+_Interp._iter_values = _iter_values
+_Interp.exec_for = _exec_for
+_Interp.exec_while = _exec_while
+_Interp._fixpoint_loop = _fixpoint_loop
+_Interp.exec_import = _exec_import
+_Interp._namespace_for = _namespace_for
+_Interp._resolve_absolute = _resolve_absolute
+
+
+# ---------------------------------------------------------------------------
+# expression evaluation (mixed into _Interp)
+# ---------------------------------------------------------------------------
+
+
+def _eval(self, node, env):
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if v is None:
+            return NONEV
+        if isinstance(v, (int, bool, float, str)):
+            return Const(v)
+        return UNK
+    if isinstance(node, ast.Name):
+        return env.get(node.id, UNK)
+    if isinstance(node, ast.Attribute):
+        return self.eval_attribute(node, env)
+    if isinstance(node, ast.Call):
+        return self.eval_call(node, env)
+    if isinstance(node, ast.Subscript):
+        return self.eval_subscript(node, env)
+    if isinstance(node, ast.BinOp):
+        a = self.eval(node.left, env)
+        b = self.eval(node.right, env)
+        return self.binop(node, node.op, a, b, env)
+    if isinstance(node, ast.UnaryOp):
+        return self.unaryop(node, env)
+    if isinstance(node, ast.Compare):
+        vals = [self.eval(c, env) for c in [node.left] + list(node.comparators)]
+        for v in vals:
+            for leaf in _leaves(v):
+                if isinstance(leaf, Wrapped):
+                    self.report_wrapped_use(leaf, node, "compared")
+        # `x is None` narrowing: the cache-refill idiom must resolve
+        # statically or every _SHIFT_CACHE lookup degrades to unknown
+        if len(node.ops) == 1 and isinstance(node.ops[0], (ast.Is, ast.IsNot)) \
+                and isinstance(node.comparators[0], ast.Constant) \
+                and node.comparators[0].value is None:
+            lv = vals[0]
+            isnone = None
+            if lv is NONEV:
+                isnone = True
+            elif isinstance(lv, (Interval, Const, Mat, MatProd, Tup, DictVal,
+                                 FuncRef)):
+                isnone = False
+            if isnone is not None:
+                if isinstance(node.ops[0], ast.IsNot):
+                    isnone = not isnone
+                return Const(isnone)
+        return Interval(0, 1, "bool")
+    if isinstance(node, ast.BoolOp):
+        for v in node.values:
+            self.eval(v, env)
+        return Interval(0, 1, "bool")
+    if isinstance(node, ast.IfExp):
+        cond = self.eval(node.test, env)
+        t = self._truthiness(cond)
+        if t is True:
+            return self.eval(node.body, env)
+        if t is False:
+            return self.eval(node.orelse, env)
+        return _join(self.eval(node.body, env), self.eval(node.orelse, env))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return Tup([self.eval(e, env) for e in node.elts])
+    if isinstance(node, ast.Dict):
+        vals = [self.eval(v, env) for v in node.values if v is not None]
+        return DictVal(_join_all(vals) if vals else None)  # None = bottom
+    if isinstance(node, ast.Set):
+        return Tup([self.eval(e, env) for e in node.elts], exact=False)
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        return self.eval_comprehension(node, env)
+    if isinstance(node, ast.DictComp):
+        cenv = dict(env)
+        self._bind_comp_generators(node.generators, cenv)
+        return DictVal(self.eval(node.value, cenv))
+    if isinstance(node, ast.Lambda):
+        return FuncRef(self.ma, node, env)
+    if isinstance(node, ast.Starred):
+        return self.eval(node.value, env)
+    if isinstance(node, ast.JoinedStr):
+        return UNK
+    if isinstance(node, ast.Slice):
+        return UNK
+    if isinstance(node, ast.Await):
+        return self.eval(node.value, env)
+    return UNK
+
+
+def _bind_comp_generators(self, generators, cenv):
+    for gen in generators:
+        it = self.eval(gen.iter, cenv)
+        vals = self._iter_values(it)
+        if vals is not None and vals:
+            self.assign(gen.target, _join_all(vals), cenv)
+        elif isinstance(it, Interval):
+            self.assign(gen.target, it, cenv)
+        elif isinstance(it, Tup) and it.items:
+            self.assign(gen.target, _join_all(it.items), cenv)
+        else:
+            self.assign(gen.target, UNK, cenv)
+        for cond in gen.ifs:
+            self.eval(cond, cenv)
+
+
+def _eval_comprehension(self, node, env):
+    # precise path: single generator over an exact finite domain
+    gen = node.generators[0]
+    it = self.eval(gen.iter, env)
+    vals = self._iter_values(it)
+    if len(node.generators) == 1 and vals is not None and len(vals) <= _UNROLL_CAP:
+        items = []
+        for v in vals:
+            cenv = dict(env)
+            self.assign(gen.target, v, cenv)
+            keep = True
+            for cond in gen.ifs:
+                t = self._truthiness(self.eval(cond, cenv))
+                if t is False:
+                    keep = False
+                elif t is None:
+                    keep = True  # over-approximate: element may be present
+            if keep:
+                items.append(self.eval(node.elt, cenv))
+        return Tup(items, exact=not gen.ifs)
+    cenv = dict(env)
+    self._bind_comp_generators(node.generators, cenv)
+    return Tup([self.eval(node.elt, cenv)], exact=False)
+
+
+def _eval_attribute(self, node, env):
+    base = self.eval(node.value, env)
+    attr = node.attr
+    if isinstance(base, ModRef):
+        menv = self.module_env(base.ma.module)
+        return menv.get(attr, UNK)
+    if isinstance(base, NsRef):
+        if attr in _NS_DTYPES:
+            return DTypeRef(_NS_DTYPES[attr])
+        return NsRef(base.parts + (attr,))
+    if isinstance(base, (Interval, Wrapped, MatProd, Mat)):
+        if attr == "at":
+            return AtView(base)
+        if attr == "T":
+            return base
+        if attr in ("shape", "ndim", "size", "dtype"):
+            return UNK
+        return MethodRef(base, attr)
+    if isinstance(base, DictVal):
+        return MethodRef(base, attr)
+    if isinstance(base, Tup):
+        return MethodRef(base, attr)
+    if isinstance(base, AtView):
+        return MethodRef(base, attr)
+    return UNK
+
+
+def _eval_subscript(self, node, env):
+    base = self.eval(node.value, env)
+    if isinstance(node.slice, ast.Tuple):
+        idx_vals = [self.eval(e, env) for e in node.slice.elts]
+        idx = Tup(idx_vals)
+    else:
+        idx = self.eval(node.slice, env)
+    for leaf in _leaves(idx):
+        if isinstance(leaf, Wrapped):
+            self.report_wrapped_use(leaf, node, "used as an index")
+    if isinstance(base, (Interval, Wrapped)):
+        return base  # gather/slice/newaxis: values are a subset (+ zeros)
+    if isinstance(base, Mat):
+        return Interval(0, base.max_entry, "u32")
+    if isinstance(base, MatProd):
+        return _as_interval(base)
+    if isinstance(base, DictVal):
+        return base.val
+    if isinstance(base, AtView):
+        return base
+    if isinstance(base, Tup):
+        if isinstance(idx, Const) and isinstance(idx.v, int):
+            if base.exact and -len(base.items) <= idx.v < len(base.items):
+                return base.items[idx.v]
+            return _join_all(base.items) if base.items else UNK
+        if isinstance(node.slice, ast.Slice):
+            lo = node.slice.lower
+            hi = node.slice.upper
+            if base.exact and (lo is None or isinstance(lo, ast.Constant)) \
+                    and (hi is None or isinstance(hi, ast.Constant)) \
+                    and node.slice.step is None:
+                lov = lo.value if lo is not None else None
+                hiv = hi.value if hi is not None else None
+                return Tup(base.items[lov:hiv])
+            return Tup(base.items, exact=False)
+        return _join_all(base.items) if base.items else UNK
+    if isinstance(base, Const) and isinstance(base.v, str):
+        return UNK
+    return UNK
+
+
+def _unaryop(self, node, env):
+    v = self.eval(node.operand, env)
+    if isinstance(node.op, ast.Not):
+        t = self._truthiness(v)
+        return Const(not t) if t is not None else Interval(0, 1, "bool")
+    if isinstance(node.op, ast.Invert):
+        iv = _as_interval(v)
+        if isinstance(iv, Interval) and iv.dtype == "bool":
+            return Interval(0, 1, "bool")
+        if isinstance(v, Const) and isinstance(v.v, int):
+            return Const(~v.v)
+        return UNK
+    if isinstance(node.op, ast.USub):
+        if isinstance(v, Const) and isinstance(v.v, (int, float)):
+            return Const(-v.v)
+        iv = _as_interval(v)
+        if isinstance(iv, Interval):
+            if iv.dtype == "u32" and not iv.weak and iv.hi > 0:
+                return Wrapped(
+                    node.lineno, node.col_offset,
+                    (unparse(node) or "-x")[:48], iv.prov,
+                    "negates an unsigned value (wraps mod 2^32 for any "
+                    "nonzero input)",
+                )
+            return Interval(-iv.hi, -iv.lo, iv.dtype, weak=iv.weak)
+        return UNK
+    if isinstance(node.op, ast.UAdd):
+        return v
+    return UNK
+
+
+# ---------------------------------------------------------------------------
+# arithmetic (mixed into _Interp)
+# ---------------------------------------------------------------------------
+
+
+def _wrap(self, node, hi, prov, note):
+    return Wrapped(
+        getattr(node, "lineno", 0), getattr(node, "col_offset", 0),
+        (unparse(node) or "?")[:48], prov, note,
+    )
+
+
+def _mask_const(v) -> Optional[int]:
+    """The integer of an all-ones mask operand, else None."""
+    if isinstance(v, Const) and isinstance(v.v, int):
+        return v.v if _is_pow2_mask(v.v) else None
+    if isinstance(v, Interval) and v.lo == v.hi and isinstance(v.lo, int):
+        return v.lo if _is_pow2_mask(v.lo) else None
+    return None
+
+
+def _binop(self, node, op, a, b, env):
+    # containers: tuple concat / repeat for host lists
+    if isinstance(op, ast.Add) and isinstance(a, Tup) and isinstance(b, Tup):
+        return Tup(a.items + b.items, exact=a.exact and b.exact)
+    if isinstance(op, ast.Mult) and isinstance(a, Tup) and \
+            isinstance(b, Const) and isinstance(b.v, int):
+        if a.exact and len(a.items) * b.v <= _UNROLL_CAP:
+            return Tup(a.items * b.v)
+        return Tup(a.items, exact=False)
+
+    # mask forgiveness first: Wrapped & (2^k - 1) recovers cleanly
+    if isinstance(op, ast.BitAnd):
+        for w, other in ((a, b), (b, a)):
+            if isinstance(w, Wrapped):
+                c = _mask_const(other)
+                if c is not None:
+                    return Interval(0, c, "u32",
+                                    prov=w.chain + (f"& {c} (mod-2^32 wrap "
+                                                    "forgiven by mask)",))
+                self.report_wrapped_use(w, node, "masked with a non-2^k-1 value")
+                return UNK
+
+    # Wrapped taint propagation / reporting
+    ring = isinstance(op, (ast.Add, ast.Sub, ast.Mult))
+    for w in (a, b):
+        if isinstance(w, Wrapped):
+            if ring:
+                return w
+            self.report_wrapped_use(
+                w, node, f"used in {type(op).__name__}")
+            return UNK
+
+    if isinstance(a, Const) and isinstance(b, Const):
+        return self._const_binop(node, op, a, b)
+
+    # constant-matrix products: x[..., :, None] * M (and M * x)
+    if isinstance(op, ast.Mult):
+        for m, x in ((a, b), (b, a)):
+            if isinstance(m, Mat):
+                xi = _as_interval(x)
+                if isinstance(xi, Interval):
+                    return MatProd(xi, m.max_colsum)
+                return Mat(m.max_entry, m.max_colsum)
+
+    ia, ib = _as_interval(a), _as_interval(b)
+    if not isinstance(ia, Interval) or not isinstance(ib, Interval):
+        # `unknown & (2^k - 1)` is [0, 2^k - 1] for ANY integer input —
+        # this is how untracked host ints (int_to_limbs) become canonical
+        if isinstance(op, ast.BitAnd):
+            c = _mask_const(a) or _mask_const(b)
+            if c is not None:
+                known = ia if isinstance(ia, Interval) else (
+                    ib if isinstance(ib, Interval) else None)
+                weak = known.weak if known is not None else True
+                dt = known.dtype if known is not None else "host"
+                return Interval(0, c, dt, weak=weak)
+        # unknown on one side: a strong u32 tensor meeting an untracked
+        # value is exactly the unprovable case the rule exists for
+        known = ia if isinstance(ia, Interval) else (
+            ib if isinstance(ib, Interval) else None)
+        if (
+            known is not None
+            and known.dtype == "u32"
+            and not known.weak
+            and isinstance(op, (ast.Add, ast.Sub, ast.Mult, ast.LShift))
+        ):
+            self.report(
+                node,
+                f"cannot bound {type(op).__name__.lower()} of a uint32 "
+                f"value [{known.lo}, {known.hi}] with an untracked operand "
+                f"{(unparse(node) or '?')[:48]!r} — annotate the source "
+                "with @bounds: or suppress with a reviewed reason",
+                chain=known.prov, effects=("unprovable",),
+            )
+        return UNK
+
+    # dtype discipline
+    dt = _join_dtype(ia, ib)
+    floatish = {"f32", "f64"}
+    if dt is None or (
+        {ia.dtype, ib.dtype} & floatish
+        and "u32" in (ia.dtype, ib.dtype)
+        and not (ia.weak or ib.weak)
+    ):
+        self.report(
+            node,
+            f"implicit dtype promotion: {ia.dtype} op {ib.dtype} in "
+            f"{(unparse(node) or '?')[:48]!r}",
+            effects=("promotion",),
+        )
+        return UNK
+    if isinstance(op, ast.Div) and "u32" in (ia.dtype, ib.dtype) and not (
+        ia.weak and ib.weak
+    ):
+        self.report(
+            node,
+            "true division promotes uint32 to float — use // or a shift",
+            effects=("promotion",),
+        )
+        return UNK
+
+    checked = dt == "u32" and not (ia.weak and ib.weak)
+    prov = ia.prov + ib.prov
+
+    def _mk(lo, hi, note_ovf="exceeds 2^32 - 1", note_neg="can underflow 0"):
+        if checked and hi >= U32_MOD:
+            return self._wrap(node, hi, prov + (self._frame(node, lo, hi, dt),),
+                              f"can reach {hi} which {note_ovf}")
+        if checked and lo < 0:
+            return self._wrap(node, lo, prov + (self._frame(node, lo, hi, dt),),
+                              f"can go as low as {lo}, which {note_neg} "
+                              "(wraps mod 2^32)")
+        weak = ia.weak and ib.weak
+        new_prov = prov
+        if checked:
+            new_prov = prov + (self._frame(node, lo, hi, dt),)
+        return Interval(lo, hi, dt, weak=weak, prov=new_prov)
+
+    if isinstance(op, ast.Add):
+        return _mk(ia.lo + ib.lo, ia.hi + ib.hi)
+    if isinstance(op, ast.Sub):
+        return _mk(ia.lo - ib.hi, ia.hi - ib.lo)
+    if isinstance(op, ast.Mult):
+        combos = [ia.lo * ib.lo, ia.lo * ib.hi, ia.hi * ib.lo, ia.hi * ib.hi]
+        return _mk(min(combos), max(combos))
+    if isinstance(op, ast.LShift):
+        s_hi = ib.hi if isinstance(ib.hi, int) else 32
+        s_lo = ib.lo if isinstance(ib.lo, int) else 0
+        if s_hi > 64:
+            s_hi = 64
+        return _mk(ia.lo << max(s_lo, 0), ia.hi << max(s_hi, 0))
+    if isinstance(op, ast.RShift):
+        s_lo = ib.lo if isinstance(ib.lo, int) and ib.lo >= 0 else 0
+        s_hi = ib.hi if isinstance(ib.hi, int) and ib.hi >= 0 else 64
+        return Interval(ia.lo >> min(s_hi, 64), ia.hi >> min(s_lo, 64), dt,
+                        weak=ia.weak and ib.weak, prov=prov)
+    if isinstance(op, ast.BitAnd):
+        his = [h for h in (ia.hi, ib.hi) if isinstance(h, int) and h >= 0]
+        return Interval(0, min(his) if his else U32_MOD - 1, dt,
+                        weak=ia.weak and ib.weak, prov=prov)
+    if isinstance(op, (ast.BitOr, ast.BitXor)):
+        hi = max(_bitlen_bound(ia.hi), _bitlen_bound(ib.hi))
+        return _mk(0, hi)
+    if isinstance(op, ast.Mod):
+        if ib.lo == ib.hi and isinstance(ib.lo, int) and ib.lo > 0:
+            return Interval(0, ib.lo - 1, dt, weak=ia.weak and ib.weak,
+                            prov=prov)
+        return Interval(0, max(ib.hi - 1, 0) if isinstance(ib.hi, int) else
+                        U32_MOD - 1, dt, prov=prov)
+    if isinstance(op, ast.FloorDiv):
+        if ib.lo == ib.hi and isinstance(ib.lo, int) and ib.lo > 0:
+            return Interval(ia.lo // ib.lo, ia.hi // ib.lo, dt,
+                            weak=ia.weak and ib.weak, prov=prov)
+        return Interval(0, ia.hi, dt, prov=prov)
+    if isinstance(op, ast.Pow):
+        return UNK
+    if isinstance(op, ast.MatMult):
+        # x @ M with a 0/1 constant matrix
+        if isinstance(b, Mat):
+            return Interval(0, ia.hi * b.max_colsum, ia.dtype, prov=prov)
+        lim = self.nlimbs
+        return _mk(0, ia.hi * ib.hi * lim)
+    return UNK
+
+
+def _const_binop(self, node, op, a: Const, b: Const):
+    try:
+        x, y = a.v, b.v
+        if isinstance(op, ast.Add):
+            return Const(x + y)
+        if isinstance(op, ast.Sub):
+            return Const(x - y)
+        if isinstance(op, ast.Mult):
+            return Const(x * y)
+        if isinstance(op, ast.FloorDiv):
+            return Const(x // y)
+        if isinstance(op, ast.Mod):
+            return Const(x % y)
+        if isinstance(op, ast.Pow):
+            if isinstance(y, int) and abs(y) > 4096:
+                return UNK
+            return Const(x ** y)
+        if isinstance(op, ast.LShift):
+            return Const(x << y) if y <= 4096 else UNK
+        if isinstance(op, ast.RShift):
+            return Const(x >> y)
+        if isinstance(op, ast.BitAnd):
+            return Const(x & y)
+        if isinstance(op, ast.BitOr):
+            return Const(x | y)
+        if isinstance(op, ast.BitXor):
+            return Const(x ^ y)
+        if isinstance(op, ast.Div):
+            return Const(x / y)
+    except Exception:
+        return UNK
+    return UNK
+
+
+_Interp.eval = _eval
+_Interp._bind_comp_generators = _bind_comp_generators
+_Interp.eval_comprehension = _eval_comprehension
+_Interp.eval_attribute = _eval_attribute
+_Interp.eval_subscript = _eval_subscript
+_Interp.unaryop = _unaryop
+_Interp._wrap = _wrap
+_Interp.binop = _binop
+_Interp._const_binop = _const_binop
+
+
+# ---------------------------------------------------------------------------
+# call evaluation (mixed into _Interp)
+# ---------------------------------------------------------------------------
+
+_BUILTIN_NAMES = {
+    "len", "range", "int", "bool", "float", "str", "bytes", "min", "max",
+    "abs", "sum", "zip", "enumerate", "tuple", "list", "set", "dict",
+    "sorted", "reversed", "print", "getattr", "hasattr", "divmod", "pow",
+    "bin", "hex", "repr", "any", "all", "isinstance", "issubclass", "iter",
+    "next", "id", "round", "map", "filter", "format", "vars", "type",
+    "ValueError", "TypeError", "RuntimeError", "AssertionError",
+    "NotImplementedError", "KeyError", "IndexError", "Exception",
+    "staticmethod", "classmethod", "property", "super", "frozenset",
+}
+
+
+def _eval_call_args(self, node, env):
+    args = []
+    for a in node.args:
+        if isinstance(a, ast.Starred):
+            v = self.eval(a.value, env)
+            if isinstance(v, Tup) and v.exact:
+                args.extend(v.items)
+            else:
+                args.append(_join_all(v.items) if isinstance(v, Tup) and
+                            v.items else UNK)
+        else:
+            args.append(self.eval(a, env))
+    kwargs = {}
+    for kw in node.keywords:
+        if kw.arg is None:
+            self.eval(kw.value, env)
+            continue
+        kwargs[kw.arg] = self.eval(kw.value, env)
+    return args, kwargs
+
+
+def _eval_call(self, node, env):
+    # builtins referenced by bare name and not shadowed
+    if isinstance(node.func, ast.Name) and node.func.id not in env and \
+            node.func.id in _BUILTIN_NAMES:
+        args, kwargs = self._eval_call_args(node, env)
+        return self._builtin_call(node, node.func.id, args, kwargs)
+
+    callee = self.eval(node.func, env)
+    args, kwargs = self._eval_call_args(node, env)
+
+    if isinstance(callee, FuncRef):
+        return self.call_function(callee, args, kwargs, node)
+    if isinstance(callee, DTypeRef):
+        return self._cast(node, callee.dtype, args[0] if args else UNK)
+    if isinstance(callee, MethodRef):
+        return self._method_call(node, callee, args, kwargs)
+    if isinstance(callee, NsRef):
+        return self._ns_call(node, callee, args, kwargs, env)
+
+    # opaque callee (decorator factories, jit wrappers, pallas_call output):
+    # the identity rule — exactly one positional arg that is a FuncRef means
+    # "wrap this function", so calls through the result keep their meaning.
+    frefs = [a for a in args if isinstance(a, FuncRef)]
+    if len(args) == 1 and len(frefs) == 1:
+        return frefs[0]
+    for a in args:
+        for leaf in _leaves(a):
+            if isinstance(leaf, Wrapped):
+                self.report_wrapped_use(leaf, node, "passed to an untracked call")
+    return UNK
+
+
+def _builtin_call(self, node, name, args, kwargs):
+    a0 = args[0] if args else UNK
+    if name == "len":
+        if isinstance(a0, Tup) and a0.exact:
+            return Const(len(a0.items))
+        if isinstance(a0, Const) and isinstance(a0.v, (str, range)):
+            return Const(len(a0.v))
+        return UNK
+    if name == "range":
+        cs = [a for a in args if isinstance(a, Const) and isinstance(a.v, int)]
+        if len(cs) == len(args) and 1 <= len(args) <= 3:
+            try:
+                return Const(range(*[c.v for c in cs]))
+            except Exception:
+                return UNK
+        return UNK
+    if name in ("int", "round"):
+        if isinstance(a0, Const) and isinstance(a0.v, (int, float, str)):
+            try:
+                return Const(int(a0.v))
+            except Exception:
+                return UNK
+        return UNK
+    if name == "bool":
+        t = self._truthiness(a0)
+        return Const(t) if t is not None else Interval(0, 1, "bool")
+    if name == "abs":
+        if isinstance(a0, Const) and isinstance(a0.v, (int, float)):
+            return Const(abs(a0.v))
+        return a0
+    if name in ("min", "max"):
+        ivs = [_as_interval(a) for a in args]
+        if args and all(isinstance(i, Interval) for i in ivs):
+            if name == "min":
+                return Interval(min(i.lo for i in ivs), min(i.hi for i in ivs),
+                                ivs[0].dtype, weak=all(i.weak for i in ivs))
+            return Interval(max(i.lo for i in ivs), max(i.hi for i in ivs),
+                            ivs[0].dtype, weak=all(i.weak for i in ivs))
+        return UNK
+    if name in ("tuple", "list", "sorted", "reversed", "set", "frozenset"):
+        if isinstance(a0, Tup):
+            return Tup(a0.items, exact=a0.exact and name in ("tuple", "list"))
+        if isinstance(a0, Const) and isinstance(a0.v, range):
+            if len(a0.v) <= _UNROLL_CAP:
+                return Tup([Const(i) for i in a0.v])
+        return Tup([a0], exact=False) if a0 is not UNK else UNK
+    if name == "zip":
+        tups = [a for a in args if isinstance(a, Tup) and a.exact]
+        if len(tups) == len(args) and args:
+            n = min(len(t.items) for t in tups)
+            return Tup([Tup([t.items[i] for t in tups]) for i in range(n)])
+        elems = []
+        for a in args:
+            if isinstance(a, Tup):
+                elems.append(_join_all(a.items) if a.items else UNK)
+            else:
+                elems.append(UNK)
+        return Tup([Tup(elems)], exact=False)
+    if name == "enumerate":
+        if isinstance(a0, Tup) and a0.exact:
+            return Tup([Tup([Const(i), v]) for i, v in enumerate(a0.items)])
+        elem = _join_all(a0.items) if isinstance(a0, Tup) and a0.items else UNK
+        return Tup([Tup([UNK, elem])], exact=False)
+    if name == "sum":
+        if isinstance(a0, Tup):
+            vals = a0.items
+            if all(isinstance(v, Const) and isinstance(v.v, (int, float))
+                   for v in vals):
+                return Const(sum(v.v for v in vals))
+        return UNK
+    if name in ("bin", "hex", "str", "repr", "format"):
+        if isinstance(a0, Const):
+            try:
+                return Const({"bin": bin, "hex": hex, "str": str,
+                              "repr": repr, "format": format}[name](a0.v))
+            except Exception:
+                return UNK
+        return UNK
+    if name == "pow":
+        if len(args) >= 2 and all(isinstance(a, Const) for a in args[:3]):
+            try:
+                return Const(pow(*[a.v for a in args[:3]]))
+            except Exception:
+                return UNK
+        return UNK
+    if name == "divmod":
+        if isinstance(a0, Const) and len(args) > 1 and \
+                isinstance(args[1], Const):
+            try:
+                q, r = divmod(a0.v, args[1].v)
+                return Tup([Const(q), Const(r)])
+            except Exception:
+                return UNK
+        return UNK
+    if name in ("isinstance", "issubclass", "hasattr"):
+        return Interval(0, 1, "bool")
+    if name in ("any", "all"):
+        return Interval(0, 1, "bool")
+    return UNK
+
+
+def _cast(self, node, dtype, v):
+    """Explicit dtype constructor / .astype: retype, checking range."""
+    if isinstance(v, Wrapped):
+        return v  # a cast does not undo a wrap; only a 2^k-1 mask does
+    if isinstance(v, (Mat, MatProd)):
+        return v  # 0/1 constant matrices keep their column-sum precision
+    if dtype == "bool":
+        return Interval(0, 1, "bool")
+    if isinstance(v, Const) and isinstance(v.v, (int, bool)):
+        iv = int(v.v)
+        if dtype == "u32" and not (0 <= iv < U32_MOD):
+            return self._wrap(node, iv, (),
+                              f"casts {iv} to uint32 (wraps mod 2^32)")
+        return Interval(iv, iv, dtype)
+    i = _as_interval(v)
+    if isinstance(i, Interval):
+        if dtype == "u32" and not i.weak and (i.lo < 0 or i.hi >= U32_MOD):
+            return self._wrap(
+                node, i.hi, i.prov,
+                f"casts [{i.lo}, {i.hi}] to uint32, which truncates mod 2^32")
+        return Interval(max(i.lo, 0) if dtype == "u32" else i.lo, i.hi,
+                        dtype, prov=i.prov)
+    # untracked input: stay untracked — inventing [0, 2^32-1] would make
+    # every downstream add/sub look like an overflow
+    return UNK
+
+
+def _method_call(self, node, mref: MethodRef, args, kwargs):
+    recv, name = mref.recv, mref.name
+    a0 = args[0] if args else UNK
+    if isinstance(recv, AtView):
+        if name == "set":
+            return _join(recv.base, a0)
+        if name == "add":
+            return self.binop(node, ast.Add(), recv.base, a0, {})
+        if name in ("multiply", "mul"):
+            return self.binop(node, ast.Mult(), recv.base, a0, {})
+        if name in ("max", "min"):
+            return _join(recv.base, a0)
+        return UNK
+    if isinstance(recv, Wrapped):
+        if name in ("reshape", "transpose", "copy", "ravel", "flatten",
+                    "squeeze", "swapaxes"):
+            return recv
+        self.report_wrapped_use(recv, node, f"used via .{name}()")
+        return UNK
+    if isinstance(recv, (Interval, Mat, MatProd)):
+        if name == "sum":
+            return self._tensor_sum(node, recv)
+        if name == "astype":
+            dt = a0.dtype if isinstance(a0, DTypeRef) else None
+            if dt is None and isinstance(kwargs.get("dtype"), DTypeRef):
+                dt = kwargs["dtype"].dtype
+            return self._cast(node, dt, recv) if dt else UNK
+        if name in ("reshape", "transpose", "copy", "ravel", "flatten",
+                    "squeeze", "swapaxes", "max", "min", "clip", "item",
+                    "block_until_ready"):
+            if name == "clip" and args:
+                hi = _as_interval(args[-1])
+                base = _as_interval(recv)
+                if isinstance(hi, Interval) and isinstance(base, Interval):
+                    return Interval(base.lo, min(base.hi, hi.hi), base.dtype,
+                                    prov=base.prov)
+            return recv
+        if name in ("all", "any"):
+            return Interval(0, 1, "bool")
+        if name in ("tolist",):
+            return Tup([_as_interval(recv)], exact=False)
+        return UNK
+    if isinstance(recv, DictVal):
+        if name == "get":
+            d = args[1] if len(args) > 1 else NONEV
+            return _join(recv.val, d)
+        if name == "setdefault":
+            d = args[1] if len(args) > 1 else NONEV
+            recv.val = _join(recv.val, d)
+            return recv.val
+        if name == "values":
+            return Tup([recv.val], exact=False)
+        if name in ("items",):
+            return Tup([Tup([UNK, recv.val])], exact=False)
+        if name in ("keys",):
+            return Tup([UNK], exact=False)
+        if name == "pop":
+            return recv.val
+        return UNK
+    if isinstance(recv, Tup):
+        if name in ("append", "add"):
+            if recv.exact and len(recv.items) < _UNROLL_CAP:
+                recv.items.append(a0)
+            else:
+                recv.items = [_join_all(recv.items + [a0])] if recv.items \
+                    else [a0]
+                recv.exact = False
+            return NONEV
+        if name == "extend":
+            if isinstance(a0, Tup) and a0.exact and recv.exact and \
+                    len(recv.items) + len(a0.items) <= _UNROLL_CAP:
+                recv.items.extend(a0.items)
+            else:
+                recv.exact = False
+            return NONEV
+        if name in ("pop",):
+            if recv.exact and recv.items:
+                return recv.items.pop()
+            return _join_all(recv.items) if recv.items else UNK
+        if name in ("index", "count"):
+            return UNK
+        if name == "copy":
+            return Tup(recv.items, exact=recv.exact)
+        return UNK
+    if isinstance(recv, Const) and isinstance(recv.v, str):
+        return UNK
+    return UNK
+
+
+def _tensor_sum(self, node, recv):
+    """Reduction semantics: contraction axes are at most NLIMBS long."""
+    if isinstance(recv, MatProd):
+        hi = recv.iv.hi * recv.colsum
+        lo = 0
+        prov = recv.iv.prov
+        dt, weak = recv.iv.dtype, recv.iv.weak
+    else:
+        i = _as_interval(recv)
+        if not isinstance(i, Interval):
+            return UNK
+        hi = i.hi * self.nlimbs
+        lo = min(i.lo, 0) * self.nlimbs
+        prov = i.prov
+        dt, weak = i.dtype, i.weak
+    if dt == "u32" and not weak and hi >= U32_MOD:
+        return self._wrap(node, hi,
+                          prov + (self._frame(node, lo, hi, dt),),
+                          f"sums to at most {hi}, which exceeds 2^32 - 1")
+    return Interval(lo, hi, dt, weak=weak,
+                    prov=prov + (self._frame(node, lo, hi, dt),)
+                    if dt == "u32" and not weak else prov)
+
+
+def _call_callable(self, f, args, node):
+    """Invoke an abstract callable (FuncRef or opaque) with abstract args."""
+    if isinstance(f, FuncRef):
+        return self.call_function(f, args, {}, node)
+    return UNK
+
+
+def _scan_like(self, node, body, carry, x_elem, with_index=False):
+    """lax.scan / fori_loop: iterate the body up to NLIMBS joined steps
+    (domain assumption: static trip counts in these kernels are <= NLIMBS
+    or the 64-bit loop over constant-bounded state), findings suppressed;
+    one final reported pass on the join."""
+    prev_rep, self.report_on = self.report_on, False
+    try:
+        steps = max(self.nlimbs, 2)
+        for _ in range(steps):
+            a = [UNK, carry] if with_index else [carry, x_elem]
+            ret = self._call_callable(body, a, node)
+            new_carry = ret
+            if not with_index:
+                if isinstance(ret, Tup) and ret.exact and len(ret.items) == 2:
+                    new_carry = ret.items[0]
+                else:
+                    new_carry = UNK
+            joined = _join(carry, new_carry)
+            if _leq(joined, carry):
+                carry = joined
+                break
+            carry = joined
+    finally:
+        self.report_on = prev_rep
+    a = [UNK, carry] if with_index else [carry, x_elem]
+    ret = self._call_callable(body, a, node)
+    if with_index:
+        return _join(carry, ret)
+    ys = UNK
+    final_carry = UNK
+    if isinstance(ret, Tup) and ret.exact and len(ret.items) == 2:
+        final_carry, ys = ret.items
+    return Tup([_join(carry, final_carry), ys])
+
+
+def _ns_call(self, node, ns: NsRef, args, kwargs, env):
+    parts = ns.parts
+    name = parts[-1]
+    scope = parts[-2] if len(parts) > 1 else ""
+    a0 = args[0] if args else UNK
+
+    kw_dtype = None
+    if isinstance(kwargs.get("dtype"), DTypeRef):
+        kw_dtype = kwargs["dtype"].dtype
+    for a in args:
+        if isinstance(a, DTypeRef):
+            kw_dtype = kw_dtype or a.dtype
+
+    def _retyped(v):
+        return self._cast(node, kw_dtype, v) if kw_dtype else v
+
+    # -- jax.tree.* --------------------------------------------------
+    if scope in ("tree", "tree_util") and name in ("map", "tree_map"):
+        f, trees = a0, args[1:]
+        return self._tree_map(node, f, trees)
+    if scope in ("tree", "tree_util") and name in ("leaves", "tree_leaves"):
+        return Tup(list(_leaves(a0)) or [UNK], exact=False)
+
+    # -- jax.lax.* ---------------------------------------------------
+    if scope == "lax":
+        if name == "scan":
+            body = a0
+            carry = args[1] if len(args) > 1 else kwargs.get("init", UNK)
+            xs = args[2] if len(args) > 2 else kwargs.get("xs", UNK)
+            x_elem = xs  # element of a leading-axis slice keeps the bound
+            if isinstance(xs, Tup):
+                x_elem = Tup(xs.items, exact=xs.exact)
+            return self._scan_like(node, body, carry, x_elem)
+        if name == "fori_loop":
+            body = args[2] if len(args) > 2 else UNK
+            init = args[3] if len(args) > 3 else UNK
+            return self._scan_like(node, body, init, UNK, with_index=True)
+        if name == "while_loop":
+            body = args[1] if len(args) > 1 else UNK
+            init = args[2] if len(args) > 2 else UNK
+            return self._scan_like(node, body, init, UNK, with_index=True)
+        if name == "cond":
+            t = self._call_callable(args[1] if len(args) > 1 else UNK,
+                                    list(args[3:]), node)
+            f = self._call_callable(args[2] if len(args) > 2 else UNK,
+                                    list(args[3:]), node)
+            return _join(t, f)
+        if name == "switch":
+            branches = args[1] if len(args) > 1 else UNK
+            operands = list(args[2:])
+            outs = []
+            if isinstance(branches, Tup):
+                for b in branches.items:
+                    outs.append(self._call_callable(b, operands, node))
+            return _join_all(outs) if outs else UNK
+        if name == "select":
+            return _join(args[1] if len(args) > 1 else UNK,
+                         args[2] if len(args) > 2 else UNK)
+        if name in ("slice_in_dim", "dynamic_slice_in_dim", "dynamic_slice",
+                    "squeeze", "expand_dims", "broadcast_in_dim",
+                    "stop_gradient", "rev", "dynamic_index_in_dim"):
+            return a0
+        if name in ("convert_element_type",):
+            return self._cast(node, kw_dtype or (
+                args[1].dtype if len(args) > 1 and
+                isinstance(args[1], DTypeRef) else None) or "u32", a0)
+        return UNK
+
+    # -- array constructors ------------------------------------------
+    if name in ("asarray", "array", "ascontiguousarray"):
+        v = a0
+        if isinstance(v, Tup):
+            leaves = [x for x in _leaves(v)]
+            ivs = [_as_interval(x) for x in leaves]
+            if leaves and all(isinstance(i, Interval) for i in ivs):
+                out = Interval(min(i.lo for i in ivs), max(i.hi for i in ivs),
+                               kw_dtype or ivs[0].dtype,
+                               weak=not kw_dtype and all(i.weak for i in ivs))
+                return out
+            return _retyped(UNK)
+        if isinstance(v, Const) and isinstance(v.v, (int, bool)):
+            return self._cast(node, kw_dtype or "i64", v) if kw_dtype \
+                else Interval(int(v.v), int(v.v), "i64", weak=True)
+        return _retyped(v)
+    if name in ("zeros", "zeros_like", "empty", "empty_like"):
+        ref = a0 if name.endswith("_like") else None
+        dt = kw_dtype
+        if dt is None and isinstance(ref, Interval):
+            dt = ref.dtype
+        return Interval(0, 0, dt or "f32")
+    if name in ("ones", "ones_like", "full", "full_like"):
+        if name.startswith("full"):
+            fill = args[1] if len(args) > 1 else kwargs.get("fill_value", UNK)
+            fi = _as_interval(fill)
+            if isinstance(fi, Interval):
+                return Interval(fi.lo, fi.hi, kw_dtype or fi.dtype)
+            return UNK
+        ref = a0 if name.endswith("_like") else None
+        dt = kw_dtype or (ref.dtype if isinstance(ref, Interval) else "f32")
+        return Interval(1, 1, dt)
+    if name == "eye":
+        return Mat(1, 1)
+    if name == "arange":
+        ivs = [_as_interval(a) for a in args[:3]]
+        if ivs and all(isinstance(i, Interval) for i in ivs):
+            hi = (ivs[1].hi if len(ivs) > 1 else ivs[0].hi)
+            return Interval(0 if len(ivs) < 2 else ivs[0].lo,
+                            max(hi - 1, 0), kw_dtype or "i32")
+        return Interval(0, U32_MOD - 1, kw_dtype or "i32")
+
+    # -- shape-preserving / selection --------------------------------
+    if name in ("broadcast_to", "reshape", "moveaxis", "transpose", "roll",
+                "flip", "squeeze", "expand_dims", "tile", "swapaxes",
+                "ravel", "atleast_1d", "atleast_2d", "copy", "repeat",
+                "take", "take_along_axis", "flipud", "fliplr"):
+        return a0
+    if name == "broadcast_arrays":
+        return Tup(list(args))
+    if name in ("concatenate", "stack", "hstack", "vstack", "block"):
+        if isinstance(a0, Tup):
+            vals = list(_leaves(a0))
+            return _join_all(vals) if vals else UNK
+        return a0
+    if name == "pad":
+        i = _as_interval(a0)
+        if isinstance(i, Interval):
+            return Interval(min(i.lo, 0), max(i.hi, 0), i.dtype, prov=i.prov)
+        return a0
+    if name in ("where", "select"):
+        if name == "select" and isinstance(a0, Tup) and len(args) > 1 and \
+                isinstance(args[1], Tup):
+            cases = list(_leaves(args[1]))
+            default = args[2] if len(args) > 2 else None
+            if default is not None:
+                cases.append(default)
+            return _join_all(cases) if cases else UNK
+        return _join(args[1] if len(args) > 1 else UNK,
+                     args[2] if len(args) > 2 else UNK)
+    if name in ("minimum", "fmin"):
+        ia, ib = _as_interval(a0), _as_interval(args[1] if len(args) > 1
+                                                else UNK)
+        if isinstance(ia, Interval) and isinstance(ib, Interval):
+            return Interval(min(ia.lo, ib.lo), min(ia.hi, ib.hi),
+                            _join_dtype(ia, ib) or ia.dtype,
+                            weak=ia.weak and ib.weak)
+        return UNK
+    if name in ("maximum", "fmax"):
+        ia, ib = _as_interval(a0), _as_interval(args[1] if len(args) > 1
+                                                else UNK)
+        if isinstance(ia, Interval) and isinstance(ib, Interval):
+            return Interval(max(ia.lo, ib.lo), max(ia.hi, ib.hi),
+                            _join_dtype(ia, ib) or ia.dtype,
+                            weak=ia.weak and ib.weak)
+        return UNK
+    if name == "sum":
+        return self._tensor_sum(node, a0)
+    if name in ("max", "amax", "min", "amin"):
+        return a0 if isinstance(a0, (Interval, Wrapped)) else _as_interval(a0)
+    if name in ("all", "any", "logical_and", "logical_or", "logical_not",
+                "equal", "not_equal", "less", "greater", "isin"):
+        for a in args:
+            for leaf in _leaves(a):
+                if isinstance(leaf, Wrapped):
+                    self.report_wrapped_use(leaf, node, f"fed to {name}()")
+        return Interval(0, 1, "bool")
+    if name in ("bitwise_and",):
+        return self.binop(node, ast.BitAnd(), a0,
+                          args[1] if len(args) > 1 else UNK, env)
+    if name in ("bitwise_or",):
+        return self.binop(node, ast.BitOr(), a0,
+                          args[1] if len(args) > 1 else UNK, env)
+    if name in ("right_shift",):
+        return self.binop(node, ast.RShift(), a0,
+                          args[1] if len(args) > 1 else UNK, env)
+    if name in ("left_shift",):
+        return self.binop(node, ast.LShift(), a0,
+                          args[1] if len(args) > 1 else UNK, env)
+    if name in ("matmul", "dot", "einsum", "tensordot"):
+        ia = _as_interval(a0)
+        mb = args[1] if len(args) > 1 else UNK
+        if isinstance(mb, Mat) and isinstance(ia, Interval):
+            return Interval(0, ia.hi * mb.max_colsum, ia.dtype, prov=ia.prov)
+        ib = _as_interval(mb)
+        if isinstance(ia, Interval) and isinstance(ib, Interval):
+            return self._tensor_sum(
+                node, self.binop(node, ast.Mult(), ia, ib, env))
+        return UNK
+
+    # -- functools ----------------------------------------------------
+    if parts[0] == "functools":
+        if name in ("lru_cache", "cache", "wraps"):
+            if len(args) == 1 and isinstance(a0, FuncRef):
+                return a0
+            return UNK  # factory form: opaque decorator, identity rule later
+        if name == "partial":
+            return a0  # approximation: drop bound args (seeded canonically)
+        if name == "reduce":
+            return UNK
+        return UNK
+
+    # -- generic jax wrappers (jit, named_call, checkpoint, custom_jvp) --
+    frefs = [a for a in args if isinstance(a, FuncRef)]
+    if len(frefs) == 1 and len(args) >= 1 and args[0] is frefs[0]:
+        return frefs[0]
+    for a in args:
+        for leaf in _leaves(a):
+            if isinstance(leaf, Wrapped):
+                self.report_wrapped_use(
+                    leaf, node, f"passed to {'.'.join(parts)}()")
+    return UNK
+
+
+def _tree_map(self, node, f, trees):
+    """jax.tree.map: rebuild the first tree's structure, applying f to
+    corresponding leaves (joined when structures disagree)."""
+    if not trees:
+        return UNK
+
+    def rec(subtrees):
+        first = subtrees[0]
+        if isinstance(first, Tup) and first.exact:
+            n = len(first.items)
+            rest_ok = all(isinstance(t, Tup) and t.exact and
+                          len(t.items) == n for t in subtrees[1:])
+            if rest_ok:
+                return Tup([rec([t.items[i] for t in subtrees])
+                            for i in range(n)])
+        leaves = [_join_all(list(_leaves(t))) if isinstance(t, Tup)
+                  else t for t in subtrees]
+        return self._call_callable(f, leaves, node)
+
+    return rec(list(trees))
+
+
+_Interp._eval_call_args = _eval_call_args
+_Interp.eval_call = _eval_call
+_Interp._builtin_call = _builtin_call
+_Interp._cast = _cast
+_Interp._method_call = _method_call
+_Interp._tensor_sum = _tensor_sum
+_Interp._call_callable = _call_callable
+_Interp._scan_like = _scan_like
+_Interp._ns_call = _ns_call
+_Interp._tree_map = _tree_map
+
+
+# ===========================================================================
+# rule: limb-bounds
+# ===========================================================================
+
+
+@register
+class LimbBounds(ProjectRule):
+    id = "limb-bounds"
+    description = (
+        "abstract interpreter over the BLS12-381 limb kernels: every "
+        "uint32 expression stays below 2^32 and no implicit dtype "
+        "promotion sneaks in (intervals seeded from canonical limbs and "
+        "docstring @bounds: annotations)"
+    )
+
+    def check_project(self, project) -> List[Finding]:
+        analyses: Dict[str, ModuleAnalysis] = {}
+        for s in project.summaries.values():
+            if s.get("bounds_src"):
+                try:
+                    analyses[s["module"]] = ModuleAnalysis(s)
+                except SyntaxError:
+                    continue
+        if not analyses:
+            return []
+        interp = _Interp(analyses)
+        for module in sorted(analyses, key=lambda m: analyses[m].path):
+            ma = analyses[module]
+            interp.module_env(module)
+            for fname in ma.funcs:
+                fnode = ma.funcs[fname]
+                try:
+                    interp.run_function(ma, fnode)
+                except RecursionError:
+                    continue
+        out = []
+        for f in interp.findings.values():
+            if project.suppressed(f.path, f.line, self.id):
+                continue
+            out.append(f)
+        out.sort(key=lambda f: (f.path, f.line, f.col))
+        return out
+
+
+# ===========================================================================
+# rule: fault-coverage
+# ===========================================================================
+
+_FAULT_DOC = "docs/FAULTS.md"
+_FAULT_NAME_RE = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
+
+
+def _documented_fault_names() -> Set[str]:
+    p = os.path.join(REPO_ROOT, _FAULT_DOC)
+    try:
+        with open(p, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return set()
+    return set(_FAULT_NAME_RE.findall(text))
+
+
+@register
+class FaultCoverage(ProjectRule):
+    id = "fault-coverage"
+    description = (
+        "every faults.fire(name) checkpoint in lodestar_tpu/ has a "
+        "docs/FAULTS.md row and at least one test injects it"
+    )
+
+    def check_project(self, project) -> List[Finding]:
+        documented = _documented_fault_names()
+        injected: Set[str] = set()
+        has_tests = False
+        for s in project.summaries.values():
+            if not s["path"].startswith("tests/"):
+                continue
+            has_tests = True
+            for rec in s.get("fault_injects", []):
+                if rec.get("name"):
+                    injected.add(rec["name"])
+        out: List[Finding] = []
+        for s in sorted(project.summaries.values(), key=lambda s: s["path"]):
+            path = s["path"]
+            if not path.startswith("lodestar_tpu/"):
+                continue
+            for rec in s.get("fault_fires", []):
+                if project.suppressed(path, rec["line"], self.id):
+                    continue
+                name = rec.get("name")
+                if name is None:
+                    out.append(Finding(
+                        path=path, line=rec["line"], col=rec["col"],
+                        rule=self.id,
+                        message=(
+                            f"fault checkpoint name {rec['expr']!r} is not "
+                            "statically resolvable — use a literal or a "
+                            "constant f-string so coverage can be checked"
+                        ),
+                    ))
+                    continue
+                if name not in documented:
+                    out.append(Finding(
+                        path=path, line=rec["line"], col=rec["col"],
+                        rule=self.id,
+                        message=(
+                            f"fault checkpoint {name!r} has no row in "
+                            f"{_FAULT_DOC} — document its failure mode "
+                            "and blast radius"
+                        ),
+                    ))
+                    continue
+                if has_tests and name not in injected:
+                    out.append(Finding(
+                        path=path, line=rec["line"], col=rec["col"],
+                        rule=self.id,
+                        message=(
+                            f"fault checkpoint {name!r} is documented but "
+                            "no test ever injects it — add a chaos test "
+                            "with faults.inject(...) covering this point"
+                        ),
+                    ))
+        return out
+
+
+# ===========================================================================
+# rule: task-lifecycle
+# ===========================================================================
+
+_LIFECYCLE_ROOTS = (
+    "close", "aclose", "stop", "shutdown", "disconnect", "abort", "__aexit__",
+)
+
+
+@register
+class TaskLifecycle(ProjectRule):
+    id = "task-lifecycle"
+    description = (
+        "every create_task/ensure_future result flows to a field or "
+        "collection that some close()/stop()-reachable path cancels or "
+        "awaits"
+    )
+
+    def _reachable(self, project, roots: Sequence[str]) -> Set[str]:
+        seen: Set[str] = set()
+        frontier = [fq for fq in roots if fq in project.funcs]
+        while frontier:
+            fq = frontier.pop()
+            if fq in seen:
+                continue
+            seen.add(fq)
+            for e in project.funcs[fq].edges:
+                if e.callee in project.funcs and e.callee not in seen:
+                    frontier.append(e.callee)
+        return seen
+
+    def check_project(self, project) -> List[Finding]:
+        # fq -> the extractor function record (for task_cancels lookup)
+        recs: Dict[str, dict] = {}
+        for s in project.summaries.values():
+            for fs in s["functions"]:
+                recs[f"{s['module']}:{fs['qname']}"] = fs
+
+        def cancels(reachable: Set[str], attr: str) -> bool:
+            return any(
+                attr in recs.get(fq, {}).get("task_cancels", [])
+                for fq in reachable
+            )
+
+        out: List[Finding] = []
+        for s in sorted(project.summaries.values(), key=lambda s: s["path"]):
+            path = s["path"]
+            if not path.startswith("lodestar_tpu/"):
+                continue
+            module = s["module"]
+            mod_roots = [
+                f"{module}:{fs['qname']}" for fs in s["functions"]
+                if fs["qname"].rsplit(".", 1)[-1] in _LIFECYCLE_ROOTS
+            ]
+            for fs in s["functions"]:
+                for bind in fs.get("task_binds", []):
+                    if bind.get("handled"):
+                        continue
+                    if project.suppressed(path, bind["line"], self.id):
+                        continue
+                    kind, attr = bind["kind"], bind.get("attr")
+                    if kind == "local":
+                        out.append(Finding(
+                            path=path, line=bind["line"], col=bind["col"],
+                            rule=self.id,
+                            message=(
+                                "spawned task is never awaited, cancelled, "
+                                "or stored where a lifecycle path can reach "
+                                "it — it outlives its owner on shutdown"
+                            ),
+                        ))
+                        continue
+                    cls = fs.get("cls") if kind == "self_attr" else None
+                    if cls is not None:
+                        roots = [
+                            fq for fq in (
+                                project._mro_method(module, cls, m)
+                                for m in _LIFECYCLE_ROOTS
+                            ) if fq
+                        ]
+                        owner = f"class {cls}"
+                    else:
+                        roots = mod_roots
+                        owner = f"module {module}"
+                    if not roots:
+                        out.append(Finding(
+                            path=path, line=bind["line"], col=bind["col"],
+                            rule=self.id,
+                            message=(
+                                f"task stored in {attr!r} but {owner} has "
+                                "no close()/stop() lifecycle method to "
+                                "settle it"
+                            ),
+                        ))
+                        continue
+                    if not cancels(self._reachable(project, roots), attr):
+                        out.append(Finding(
+                            path=path, line=bind["line"], col=bind["col"],
+                            rule=self.id,
+                            message=(
+                                f"task stored in {attr!r} is never "
+                                "cancelled or awaited on any "
+                                "close()/stop() path of "
+                                f"{owner} — cancel it on shutdown"
+                            ),
+                        ))
+        return out
