@@ -81,23 +81,33 @@ def jitted(kernel: str):
 
 @dataclass(frozen=True)
 class Program:
-    """One compilable program: a kernel at a concrete batch bucket."""
+    """One compilable program: a kernel at a concrete batch bucket,
+    optionally sharded over a ``mesh_size``-device (sp,) mesh
+    (``mesh_size=0`` means the ordinary single-device program)."""
 
-    kernel: str  # "batch" | "hashed" | "each" | "fast_agg"
+    kernel: str  # "batch" | "hashed" | "each" | "fast_agg" | "sharded"
     bucket: int
     priority: int = 100  # warm order: lower first
     note: str = ""
+    mesh_size: int = 0  # 0 = unsharded; else devices on the (sp,) mesh
 
     @property
     def key(self) -> str:
-        return f"{self.kernel}/b{self.bucket}"
+        base = f"{self.kernel}/b{self.bucket}"
+        return f"{base}@m{self.mesh_size}" if self.mesh_size else base
 
     def fn(self):
+        if self.mesh_size:
+            from lodestar_tpu.ops.bls12_381 import sharded
+
+            return sharded.jitted_sharded(self.mesh_size)
         return jitted(self.kernel)
 
     def fn_name(self) -> str:
         """Underlying function name — the persistent-cache filename
         prefix is ``jit_<fn_name>-``."""
+        if self.mesh_size:
+            return "sharded_verify"
         return ensure_kernels()[self.kernel].__name__
 
     def example_args(self) -> tuple:
@@ -126,6 +136,10 @@ def _example_args(kernel: str, B: int) -> tuple:
     msg_aff, msg_inf = cv.encode_g2_affine([None] * B)
     if kernel == "batch":
         return (pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, bits, active)
+    if kernel == "sharded":
+        # ops/bls12_381/sharded.py arg order (active before bits,
+        # matching __graft_entry__'s dryrun signature)
+        return (pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active, bits)
     if kernel == "each":
         return (pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active)
     if kernel == "fast_agg":
@@ -218,6 +232,27 @@ def registered_programs(
                 progs.append(Program(k, b, priority=50, note="full sweep"))
         for b in bk.BUCKETS:
             progs.append(Program("fast_agg", b, priority=60, note="full sweep"))
+        # mesh-parameterized sharded verify (ops/bls12_381/sharded.py):
+        # one entry per (bucket, mesh geometry) this host can actually
+        # build — warming a sharded program on a host with too few
+        # devices would abort the whole warm run, so the gate is on
+        # live device count.  Full scope only: a cold sharded pairing
+        # compile costs hours on XLA:CPU (docs/AOT.md).
+        from lodestar_tpu.ops.bls12_381 import sharded as sh
+
+        import jax
+
+        n_dev = len(jax.devices())
+        for m in sh.SUPPORTED_MESH_SIZES:
+            if m > n_dev:
+                continue
+            for b in sh.SHARDED_BUCKETS:
+                progs.append(
+                    Program(
+                        "sharded", b, priority=70, note="sharded verify",
+                        mesh_size=m,
+                    )
+                )
     # dedupe by key, keeping the highest-priority (lowest number) entry
     seen: Dict[str, Program] = {}
     for p in sorted(progs, key=lambda p: p.priority):

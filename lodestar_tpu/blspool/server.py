@@ -35,7 +35,7 @@ from __future__ import annotations
 import asyncio
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from lodestar_tpu.chain.bls import breaker as brk
 from lodestar_tpu.chain.bls.device_pool import MAX_SIGNATURE_SETS_PER_JOB
@@ -81,13 +81,21 @@ class BlsPoolServer:
         *,
         metrics: Optional[BlsPoolSidecarMetrics] = None,
         tenant_quota: Tuple[int, int] = DEFAULT_TENANT_QUOTA,
+        weights: Optional[Dict[str, float]] = None,
         coalesce_wait_ms: float = COALESCE_WAIT_MS,
         max_sets_per_batch: int = MAX_SIGNATURE_SETS_PER_JOB,
         max_pending_sets: Optional[int] = None,
         now=time.monotonic,
     ):
         self._verifier = verifier if verifier is not None else SingleThreadBlsVerifier()
-        self._limiter = RateLimiterGCRA(tenant_quota[0], tenant_quota[1], now=now)
+        # per-tenant quota weighting (ROADMAP item 4): a tenant with
+        # weight w advances its TAT by 1/w emission intervals per
+        # admitted set, so it sustains w× the base quota under
+        # contention — and an over-weight burst still sheds without TAT
+        # mutation (tests/test_blspool.py::TestTenantWeighting).
+        self._limiter = RateLimiterGCRA(
+            tenant_quota[0], tenant_quota[1], now=now, shares=weights
+        )
         self._metrics = metrics
         self._coalesce_wait_s = coalesce_wait_ms / 1000
         self._max_sets_per_batch = max_sets_per_batch

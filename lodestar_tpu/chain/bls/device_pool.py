@@ -534,6 +534,22 @@ class DeviceBlsVerifier:
         return ok_all
 
     # ------------------------------------------------------------------
+    # multi-chip sharded path (ROADMAP item 3)
+    # ------------------------------------------------------------------
+
+    def sharded_verify_fn(self, mesh):
+        """The jitted manual-collectives sharded verification program
+        for ``mesh`` (ops/bls12_381/sharded.py) — the multi-chip twin
+        of ``_execute_device``'s single-device kernel.  Memoized per
+        geometry by the sharded module, so repeated calls share one
+        trace cache; dispatch widths must come from
+        ``sharded.SHARDED_BUCKETS`` (lodelint's shard-divisibility
+        gate pins the geometry contract)."""
+        from lodestar_tpu.ops.bls12_381 import sharded
+
+        return sharded.jitted_for_mesh(mesh)
+
+    # ------------------------------------------------------------------
     # degradation ladder (tentpole: waiters get verdicts, not exceptions)
     # ------------------------------------------------------------------
 

@@ -7,23 +7,45 @@ of weight w is allowed iff TAT <= now + burst_window.
 from __future__ import annotations
 
 import time
-from typing import Dict, Hashable
+from typing import Dict, Hashable, Optional
 
 
 class RateLimiterGCRA:
-    def __init__(self, quota: int, quota_time_ms: int, now=time.monotonic):
+    def __init__(
+        self,
+        quota: int,
+        quota_time_ms: int,
+        now=time.monotonic,
+        shares: Optional[Dict[Hashable, float]] = None,
+    ):
         """Allow `quota` units per `quota_time_ms` window with full-burst
-        tolerance (matches rateLimiterGRCA.ts::fromQuota)."""
+        tolerance (matches rateLimiterGRCA.ts::fromQuota).
+
+        ``shares`` scales a key's quota: a key with share s advances its
+        TAT by ``weight/s`` emission intervals per admitted unit, so it
+        sustains ``s * quota`` units per window (and its largest
+        admissible single request scales to ``s * quota`` units too).
+        Unlisted keys have share 1.0."""
         self._emission_ms = quota_time_ms / max(1, quota)
         self._burst_ms = quota_time_ms
         self._tat: Dict[Hashable, float] = {}
         self._now = now
+        self._shares: Dict[Hashable, float] = dict(shares or {})
 
-    def allows(self, key: Hashable, weight: int = 1) -> bool:
+    def set_share(self, key: Hashable, share: float) -> None:
+        """(Re)weight a key; share must be positive."""
+        if share <= 0:
+            raise ValueError(f"share must be positive, got {share}")
+        self._shares[key] = share
+
+    def allows(self, key: Hashable, weight: float = 1) -> bool:
         now_ms = self._now() * 1e3
         tat = self._tat.get(key, now_ms)
-        new_tat = max(tat, now_ms) + weight * self._emission_ms
+        share = self._shares.get(key, 1.0)
+        new_tat = max(tat, now_ms) + (weight / share) * self._emission_ms
         if new_tat - now_ms > self._burst_ms:
+            # shed WITHOUT mutating TAT: a rejected burst must not
+            # poison the key's own future quota
             return False
         self._tat[key] = new_tat
         return True

@@ -1,0 +1,190 @@
+"""Multi-chip sharded BLS verification — the manual-collectives
+formulation, promoted out of ``__graft_entry__`` into a production
+module the lodelint v5 shardcheck rules can see (ISSUE 19; ROADMAP
+item 3's architecture step).
+
+The SURVEY's §2.5/§7 ICI mapping: the signature-set batch axis is
+sharded over the mesh's ``sp`` axis (data parallelism over signature
+sets), each device computes its local r_i·sig_i partial sum and its
+local Miller-loop product, the partials ride the ICI via ``all_gather``,
+and one shared final exponentiation finishes the pairing check.  The
+GSPMD formulation (annotate shardings, let XLA insert the collectives)
+lives in ``__graft_entry__.dryrun_multichip``; THIS module is the
+explicit-axes twin kept for real-hardware bringup, where reading the
+collectives off the program text matters more than compile time.
+
+Geometry contract (checked statically by lodelint's ``shard-divisibility``
+and dynamically by ``tests/test_mesh_smoke.py``): every bucket in
+``SHARDED_BUCKETS`` divides evenly over every ``SUPPORTED_MESH_SIZES``
+entry, and every per-device quotient is itself a registered AOT rung, so
+a mesh dispatch never truncates, pads, or cold-compiles an unwarmed
+program shape.
+
+@mesh: sp
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+# the single mesh axis every collective in this module names: data
+# parallelism over signature sets (SURVEY §2.5 row 1)
+SHARD_AXIS = "sp"
+
+# mesh geometries the node supports (v4e-8 slice and its halvings);
+# lodelint's shard-divisibility reads this table live
+SUPPORTED_MESH_SIZES = (2, 4, 8)
+
+# dispatch widths the sharded programs accept: each divides every
+# supported mesh size AND shards to a per-device width that is itself a
+# registered AOT rung (128/8=16 ... 2048/2=1024 are all in
+# buckets.BUCKETS), so `aot warm` coverage extends to the shards
+SHARDED_BUCKETS = (128, 512, 1024, 2048)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """Version-portable ``shard_map``: new jax exposes ``jax.shard_map``
+    with a ``check_vma`` kwarg; 0.4.x only has
+    ``jax.experimental.shard_map.shard_map`` with the same check named
+    ``check_rep``.  One adapter so the production formulation (and the
+    lint contract on it) is written once against the new spelling."""
+    import jax
+
+    new = getattr(jax, "shard_map", None)
+    if new is not None:
+        return new(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    from jax.experimental.shard_map import shard_map as _old
+
+    return _old(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )
+
+
+def build_sharded_verify(mesh):
+    """Manual-collectives batched signature-set verification over
+    ``mesh``: local scalar muls + Miller products per shard, all_gather
+    + GT-product reduction over "sp", one replicated final
+    exponentiation.  Arg order matches ``__graft_entry__``'s dryrun:
+    ``(pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active,
+    bits)``.
+
+    @mesh: sp
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from lodestar_tpu.ops.bls12_381 import curve as cv, pairing as pr, tower as tw
+    from lodestar_tpu.ops.bls12_381 import verify as dv
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS),) * 8,
+        out_specs=P(),
+        # Replication is by construction (every device all_gathers the
+        # same partials and reduces them identically) but 0.4.x
+        # check_rep / 0.6.x check_vma cannot infer it: psum outputs
+        # infer as replicated, all_gather outputs do NOT, and there is
+        # no cross-device *product* collective for the GT reduction, so
+        # the gather-then-reduce shape is forced and the check must be
+        # off.  tests/test_mesh_smoke.py carries the invariant
+        # dynamically (bit-equality vs the unsharded program) and
+        # tests/test_sharded_verify.py pins that enabling the check
+        # raises.
+        check_vma=False,  # lodelint: disable=replicated-escape — all_gather+reduce replication is correct by construction but not inferrable (no product collective); bit-equality tested in test_mesh_smoke.py
+    )
+    def sharded_verify(pk_aff, pk_inf, msg_aff, msg_inf, sig_aff, sig_inf, active, bits):
+        pk_jac = cv.from_affine(cv.F1, pk_aff, pk_inf | ~active)
+        sig_jac = cv.from_affine(cv.F2, sig_aff, sig_inf | ~active)
+        rpk = cv.scalar_mul_bits(cv.F1, pk_jac, bits)
+        rsig = cv.scalar_mul_bits(cv.F2, sig_jac, bits)
+        local_sig_sum = dv.jac_reduce_add(cv.F2, rsig)
+
+        rpk_aff, rpk_inf = dv.batch_to_affine(cv.F1, rpk)
+        mask = active & ~rpk_inf & ~msg_inf
+        local_f = dv.multi_miller_product(msg_aff, rpk_aff, mask)
+
+        sums = jax.lax.all_gather(local_sig_sum, "sp")
+        fs = jax.lax.all_gather(local_f, "sp")
+        sig_sum = dv.jac_reduce_add(cv.F2, sums)
+        f_msgs = dv.f12_reduce_mul(fs)
+
+        ss_aff, ss_inf = cv.to_affine(cv.F2, sig_sum, tw.f2_inv)
+        f_sig = pr.miller_loop(ss_aff, (dv._NEG_G1_X, dv._NEG_G1_Y))
+        f_sig = tw.f12_select(ss_inf, tw.f12_one(shape=()), f_sig)
+        f = tw.f12_mul(f_msgs, f_sig)
+        return tw.f12_is_one(pr.final_exponentiation(f))
+
+    return sharded_verify
+
+
+def build_reduced_step(mesh, check_vma=False):
+    """Reduced sharded step over ``mesh``: the production curve kernels
+    (mixed Jacobian arithmetic, branch-free double-and-add scalar mul)
+    with the cross-shard Jacobian reduction made explicit — the pairing
+    (Miller + final exp) is omitted so a cold compile fits a test
+    budget.  Returns the affine sum ``((x, y), is_inf)`` so bit-equality
+    against the unsharded execution compares canonical coordinates.
+
+    ``check_vma`` is exposed so tests can pin WHY the default is off:
+    on jax 0.4.x, enabling it raises at trace time because all_gather
+    outputs are never inferred replicated (see build_sharded_verify).
+
+    @mesh: sp
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from lodestar_tpu.ops.bls12_381 import curve as cv, fp
+    from lodestar_tpu.ops.bls12_381 import verify as dv
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS),) * 4,
+        out_specs=P(),
+        check_vma=check_vma,  # lodelint: disable=replicated-escape — defaults False: Jacobian sums need gather-then-reduce (no point-add collective), which 0.4.x check_rep cannot infer replicated; test_mesh_smoke.py pins bit-equality, test_sharded_verify.py pins the raise
+    )
+    def reduced_step(pk_aff, pk_inf, bits, active):
+        pk_jac = cv.from_affine(cv.F1, pk_aff, pk_inf | ~active)
+        rpk = cv.scalar_mul_bits(cv.F1, pk_jac, bits)
+        local = dv.jac_reduce_add(cv.F1, rpk)
+        parts = jax.lax.all_gather(local, "sp")
+        total = dv.jac_reduce_add(cv.F1, parts)
+        return cv.to_affine(cv.F1, total, fp.inv)
+
+    return reduced_step
+
+
+def default_mesh(mesh_size: int):
+    """The canonical ``(sp,)`` mesh over the first ``mesh_size`` local
+    devices (the registry's enumeration gate guarantees enough exist)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()[:mesh_size]
+    if len(devices) < mesh_size:
+        raise ValueError(
+            f"sharded program needs {mesh_size} devices, have {len(devices)}"
+        )
+    return Mesh(devices, (SHARD_AXIS,))
+
+
+@lru_cache(maxsize=None)
+def jitted_for_mesh(mesh):
+    """THE memoized jitted sharded-verify program for a concrete mesh
+    (``Mesh`` is hashable) — one wrapper per geometry per process, so
+    every call site shares one trace cache and the persistent-cache
+    filename (``jit_sharded_verify-``) is stable."""
+    import jax
+
+    return jax.jit(build_sharded_verify(mesh))
+
+
+@lru_cache(maxsize=None)
+def jitted_sharded(mesh_size: int):
+    """``jitted_for_mesh`` over the canonical ``mesh_size``-device mesh
+    — the registry's ``Program.fn()`` for ``sharded/b*@m{mesh_size}``
+    entries, so warm/--check cover the sharded geometries."""
+    return jitted_for_mesh(default_mesh(mesh_size))

@@ -140,6 +140,38 @@ class TestRegistry:
         monkeypatch.setenv("BENCH_BATCH_MAX", "4096")
         assert registry.bench_buckets() == [1024, 4096]
 
+    def test_sharded_programs_enumerable_with_mesh_keys(self):
+        """ISSUE 19: the extracted sharded verify is registered as
+        (kernel, bucket, mesh_size) entries so warm/--check cover it.
+        The test env forces an 8-device virtual CPU mesh, so every
+        supported geometry must enumerate; keys carry the @m suffix;
+        example avals reuse the batch shapes in sharded.py arg order
+        (active before bits)."""
+        from lodestar_tpu.ops.bls12_381 import sharded
+
+        full = registry.registered_programs("full", device_h2c=False)
+        got = {(p.kernel, p.bucket, p.mesh_size) for p in full if p.mesh_size}
+        want = {
+            ("sharded", b, m)
+            for b in sharded.SHARDED_BUCKETS
+            for m in sharded.SUPPORTED_MESH_SIZES
+        }
+        assert got == want
+        sh = [p for p in full if p.mesh_size]
+        assert {p.key for p in sh} == {
+            f"sharded/b{b}@m{m}" for (_, b, m) in want
+        }
+        assert all(p.fn_name() == "sharded_verify" for p in sh)
+        # sharded entries are full-scope only (a cold sharded pairing
+        # compile costs hours on XLA:CPU — docs/AOT.md)
+        core = registry.registered_programs(device_h2c=False)
+        assert not any(p.mesh_size for p in core)
+        # example args: 8-tuple, bits last (sharded.py arg order)
+        p = min(sh, key=lambda p: p.bucket)
+        args = p.example_args()
+        assert len(args) == 8
+        assert args[6].dtype == bool and args[6].shape == (p.bucket,)
+
 
 # ---------------------------------------------------------------------------
 # warm + manifest

@@ -431,6 +431,87 @@ class TestGcraWeightSemantics:
         assert lim.allows("k", weight=4) is True
 
 
+class TestTenantWeighting:
+    """Per-tenant quota weighting (ROADMAP item 4 remaining): a
+    ``weights={tenant: float}`` config scales each tenant's emission
+    interval, so a weight-2 tenant sustains ~2× a weight-1 tenant's
+    admitted rate under contention — and an over-weight request still
+    sheds without TAT mutation."""
+
+    def test_weighted_tenant_sustains_proportional_rate(self):
+        t = {"now": 1000.0}
+        lim = RateLimiterGCRA(
+            10, 1000, now=lambda: t["now"], shares={"heavy": 2.0}
+        )
+        admitted = {"heavy": 0, "light": 0}
+        # contention: both tenants offer one set every 25 ms for 5 s —
+        # far above either quota, so admission is emission-limited
+        for _ in range(200):
+            t["now"] += 0.025
+            for tenant in ("heavy", "light"):
+                if lim.allows(tenant, weight=1):
+                    admitted[tenant] += 1
+        # steady state: light sustains quota (10/s), heavy 2× that;
+        # the initial burst window adds the same +quota×share headroom
+        assert admitted["light"] == pytest.approx(60, abs=2)
+        assert admitted["heavy"] == pytest.approx(120, abs=3)
+        assert admitted["heavy"] / admitted["light"] == pytest.approx(2.0, rel=0.05)
+
+    def test_weighted_overweight_sheds_without_tat_mutation(self):
+        t = {"now": 1000.0}
+        lim = RateLimiterGCRA(
+            10, 1000, now=lambda: t["now"], shares={"h": 2.0}
+        )
+        # share 2.0 scales the largest admissible single request to 20
+        assert lim.allows("h", weight=21) is False
+        # the rejection left no residue: the full scaled burst is intact
+        assert lim.allows("h", weight=20) is True
+        assert lim.allows("h", weight=1) is False
+
+    def test_set_share_validates_and_rescales(self):
+        lim = RateLimiterGCRA(10, 1000, now=lambda: 1.0)
+        with pytest.raises(ValueError):
+            lim.set_share("k", 0)
+        lim.set_share("k", 0.5)
+        # share 0.5 halves the largest admissible request
+        assert lim.allows("k", weight=6) is False
+        assert lim.allows("k", weight=5) is True
+
+    def test_server_weights_config_reaches_admission(self):
+        inner = FakeInnerVerifier()
+        server = BlsPoolServer(
+            inner, tenant_quota=(4, 60_000), weights={"heavy": 2.0}
+        )
+
+        async def go():
+            try:
+                over = await server.handle_payload(
+                    "heavy", _request("heavy", n_sets=9)
+                )
+                big = await server.handle_payload(
+                    "heavy", _request("heavy", n_sets=8)
+                )
+                light = await server.handle_payload(
+                    "light", _request("light", n_sets=5)
+                )
+                small = await server.handle_payload(
+                    "light", _request("light", n_sets=4)
+                )
+                return over, big, light, small
+            finally:
+                await server.close()
+
+        over, big, light, small = [codec.decode_response(r) for r in run(go())]
+        # weight-2 tenant: single-request capacity is 2× the base quota,
+        # and the over-weight shed did not consume any of it
+        assert over["ok"] is False and over["error"] == codec.ERR_RATE_LIMITED
+        assert big["ok"] is True and big["valid"] is True
+        # weight-1 tenant keeps the unscaled quota
+        assert light["ok"] is False and light["error"] == codec.ERR_RATE_LIMITED
+        assert small["ok"] is True and small["valid"] is True
+        assert server.shed_log == ["heavy", "light"]
+
+
 class TestChaos:
     def _pair(self, **server_kwargs):
         inner = FakeInnerVerifier()

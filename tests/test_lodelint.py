@@ -1687,7 +1687,7 @@ def test_lint_stamp_covers_every_analyzer_module():
     for required in (
         "core.py", "callgraph.py", "effects.py", "rules_async.py",
         "rules_jax.py", "rules_repo.py", "rules_interproc.py",
-        "rules_program.py", "rules_bounds.py",
+        "rules_program.py", "rules_bounds.py", "rules_shard.py",
     ):
         assert required in on_disk
     for fn in on_disk:
@@ -2377,3 +2377,300 @@ def test_task_lifecycle_negative_collection_cancelled_via_alias():
                 t.cancel()
     """
     assert not lint(src, rule="task-lifecycle")
+
+
+# ---------------------------------------------------------------------------
+# v5 shardcheck rules (ISSUE 19): collective-axis, replicated-escape,
+# shard-divisibility — static SPMD/collective safety over the call graph
+# ---------------------------------------------------------------------------
+
+
+def test_collective_axis_positive_unbound_psum():
+    # mutation demo: a psum whose axis no enclosing shard_map/pmap binds
+    # — the exact defect class the rule was built for
+    src = """
+    import jax
+    def helper(x):
+        return jax.lax.psum(x, "sp")
+    """
+    fs = lint(src, rule="collective-axis")
+    assert [f.rule for f in fs] == ["collective-axis"]
+    assert "'sp'" in fs[0].message and "not bound" in fs[0].message
+    assert fs[0].effects == ("collective:psum", "axis:sp")
+
+
+def test_collective_axis_negative_bound_by_local_mesh():
+    # the decorator's mesh= kwarg resolves to a local Mesh(...) whose
+    # axis_names bind the collective's axis
+    src = """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lodestar_tpu.ops.bls12_381.sharded import shard_map
+    def build():
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        @lambda f: shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"))
+        def body(x):
+            return jax.lax.psum(x, "sp")
+        return body
+    """
+    assert not lint(src, rule="collective-axis")
+
+
+def test_collective_axis_negative_helper_inherits_caller_axes():
+    # interprocedural closure: a helper called from inside a shard_map
+    # body inherits the body's bound axes
+    src = """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lodestar_tpu.ops.bls12_381.sharded import shard_map
+    def reduce_helper(x):
+        return jax.lax.psum(x, "sp")
+    def build():
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        @lambda f: shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"))
+        def body(x):
+            return reduce_helper(x)
+        return body
+    """
+    assert not lint(src, rule="collective-axis")
+
+
+def test_collective_axis_positive_unsharded_caller_witness_chain():
+    # a collective helper reachable ONLY from an unsharded caller is
+    # flagged WITH the witness chain proving the unbound reachability
+    src = """
+    import jax
+    def gather_helper(x):
+        return jax.lax.all_gather(x, "sp")
+    def plain_caller(x):
+        return gather_helper(x)
+    """
+    fs = lint(src, rule="collective-axis")
+    assert len(fs) == 1
+    assert fs[0].chain, "expected a witness chain through the unsharded caller"
+    assert "plain_caller" in "".join(fs[0].chain)
+
+
+def test_collective_axis_negative_mesh_docstring_contract():
+    # the `@mesh:` docstring contract declares the axis bound without a
+    # decorator in view (the sharded.py builder idiom)
+    src = '''
+    import jax
+    def helper(x):
+        """Cross-shard total.
+
+        @mesh: sp
+        """
+        return jax.lax.psum(x, "sp")
+    '''
+    assert not lint(src, rule="collective-axis")
+
+
+def test_collective_axis_negative_nonliteral_axis_underapproximates():
+    # an axis that is not a string literal contributes nothing — the
+    # rule under-approximates instead of guessing
+    src = """
+    import jax
+    def helper(x, axis):
+        return jax.lax.psum(x, axis)
+    """
+    assert not lint(src, rule="collective-axis")
+
+
+def test_collective_axis_negative_pmap_axis_name():
+    # pmap's axis_name= kwarg binds the axis for its function
+    src = """
+    import jax
+    def build():
+        @lambda f: jax.pmap(f, axis_name="dp")
+        def step(x):
+            return jax.lax.pmean(x, "dp")
+        return step
+    """
+    assert not lint(src, rule="collective-axis")
+
+
+def test_collective_axis_suppression():
+    src = """
+    import jax
+    def helper(x):
+        return jax.lax.psum(x, "sp")  # lodelint: disable=collective-axis
+    """
+    assert not lint(src, rule="collective-axis")
+
+
+def test_replicated_escape_positive_unreduced_output():
+    # mutation demo: out_specs=P() but the return value never passed
+    # through a cross-axis collective — each device returns its local
+    # shard and one copy silently wins
+    src = """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lodestar_tpu.ops.bls12_381.sharded import shard_map
+    def build():
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        @lambda f: shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P())
+        def body(x):
+            local = x * 2
+            return local
+        return body
+    """
+    fs = lint(src, rule="replicated-escape")
+    assert [f.rule for f in fs] == ["replicated-escape"]
+    assert "out_specs=P()" in fs[0].message
+    assert fs[0].effects == ("out_specs:P()",)
+
+
+def test_replicated_escape_negative_reduced_output():
+    # the return value derives (transitively, through locals) from a
+    # cross-axis collective: replication is real
+    src = """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lodestar_tpu.ops.bls12_381.sharded import shard_map
+    def build():
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        @lambda f: shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P())
+        def body(x):
+            parts = jax.lax.all_gather(x, "sp")
+            total = parts.sum()
+            return total
+        return body
+    """
+    assert not lint(src, rule="replicated-escape")
+
+
+def test_replicated_escape_positive_check_vma_false_unreviewed():
+    src = """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lodestar_tpu.ops.bls12_381.sharded import shard_map
+    def build():
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        @lambda f: shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"), check_vma=False)
+        def body(x):
+            return jax.lax.psum(x, "sp")
+        return body
+    """
+    fs = lint(src, rule="replicated-escape")
+    assert len(fs) == 1 and "check_vma=False" in fs[0].message
+    assert "check_vma:False" in fs[0].effects
+
+
+def test_replicated_escape_negative_check_vma_false_reviewed():
+    # a reviewed root suppression (with its reason) on the check_vma
+    # line is the sanctioned escape hatch — sharded.py's idiom
+    src = """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lodestar_tpu.ops.bls12_381.sharded import shard_map
+    def build():
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        @lambda f: shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"), check_vma=False)  # lodelint: disable=replicated-escape — gather+reduce not inferrable
+        def body(x):
+            return jax.lax.psum(x, "sp")
+        return body
+    """
+    assert not lint(src, rule="replicated-escape")
+
+
+def test_replicated_escape_check_vma_true_clean_dynamic_flagged():
+    head = """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lodestar_tpu.ops.bls12_381.sharded import shard_map
+    def build(flag):
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        @lambda f: shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"), check_vma={})
+        def body(x):
+            return jax.lax.psum(x, "sp")
+        return body
+    """
+    assert not lint(head.format("True"), rule="replicated-escape")
+    fs = lint(head.format("flag"), rule="replicated-escape")
+    assert len(fs) == 1 and "non-literal" in fs[0].message
+
+
+def test_shard_divisibility_positive_96_rung_on_4_mesh():
+    # mutation demo: 96 divides 4 evenly but shards to per-device width
+    # 24 — not a registered AOT rung, so every device cold-compiles an
+    # unwarmed program shape at first dispatch
+    src = """
+    SUPPORTED_MESH_SIZES = (4,)
+    SHARDED_BUCKETS = (96,)
+    """
+    fs = lint(src, rule="shard-divisibility")
+    assert len(fs) == 1
+    assert "per-device width 24" in fs[0].message
+    assert fs[0].effects == ("rung:96", "mesh:4")
+
+
+def test_shard_divisibility_positive_indivisible_rung():
+    src = """
+    SUPPORTED_MESH_SIZES = (8,)
+    SHARDED_BUCKETS = (100,)
+    """
+    fs = lint(src, rule="shard-divisibility")
+    assert len(fs) == 1
+    assert "not divisible" in fs[0].message
+    assert fs[0].effects == ("rung:100", "mesh:8")
+
+
+def test_shard_divisibility_negative_clean_table():
+    # every rung divides every mesh size AND every quotient is itself a
+    # registered rung (the production sharded.py invariant)
+    src = """
+    SUPPORTED_MESH_SIZES = (2, 4, 8)
+    SHARDED_BUCKETS = (128, 512, 1024, 2048)
+    """
+    assert not lint(src, rule="shard-divisibility")
+
+
+def test_shard_divisibility_pool_buckets_feed_sharded_default_meshes():
+    # POOL_BUCKETS are sharded-reachable dispatch widths; with no
+    # SUPPORTED_MESH_SIZES in view the default 2/4/8 geometry applies
+    src = """
+    POOL_BUCKETS = (24,)
+    """
+    fs = lint(src, rule="shard-divisibility")
+    assert fs and all(f.rule == "shard-divisibility" for f in fs)
+    assert any("mesh:8" in f.effects[1] for f in fs)
+
+
+def test_shard_divisibility_suppression_on_table_line():
+    src = """
+    SUPPORTED_MESH_SIZES = (4,)
+    SHARDED_BUCKETS = (96,)  # lodelint: disable=shard-divisibility — host-only table
+    """
+    assert not lint(src, rule="shard-divisibility")
+
+
+def test_v5_rules_report_axis_and_spec_payload_in_json():
+    # the --json schema: shardcheck findings carry the axis/spec payload
+    # in effects through the same as_json() the CLI serializes
+    src = """
+    import jax
+    def helper(x):
+        return jax.lax.psum(x, "nope")
+    def caller(x):
+        return helper(x)
+    """
+    fs = lint(src, rule="collective-axis")
+    assert fs
+    j = fs[0].as_json()
+    assert j["effects"] == ["collective:psum", "axis:nope"]
+    assert j["rule"] == "collective-axis" and j["chain"]
+
+    src2 = """
+    SUPPORTED_MESH_SIZES = (4,)
+    SHARDED_BUCKETS = (96,)
+    """
+    j2 = lint(src2, rule="shard-divisibility")[0].as_json()
+    assert j2["effects"] == ["rung:96", "mesh:4"]

@@ -20,11 +20,16 @@ def test_virtual_mesh_has_8_devices():
 
 
 def test_sharded_psum_over_mesh():
+    # the version-portable wrapper from the production sharded module:
+    # new jax spells it jax.shard_map/check_vma, 0.4.x spells it
+    # jax.experimental.shard_map/check_rep
+    from lodestar_tpu.ops.bls12_381.sharded import shard_map
+
     devices = jax.devices("cpu")[:8]
     mesh = Mesh(np.array(devices), ("sp",))
 
     @jax.jit
-    @lambda f: jax.shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P())
+    @lambda f: shard_map(f, mesh=mesh, in_specs=P("sp"), out_specs=P())
     def total(x):
         return jax.lax.psum(jnp.sum(x), "sp")
 
@@ -60,3 +65,69 @@ def test_limb_add_sharded_matches_single_device():
     b_s = jax.device_put(b, shard)
     got = jax.jit(fp.add)(a_s, b_s)
     assert jnp.array_equal(want, got)
+
+
+def test_reduced_step_bit_identical_across_formulations():
+    """ISSUE 19 satellite: ops/bls12_381/sharded.py's reduced step
+    (manual shard_map + all_gather) must be bit-identical to BOTH the
+    fully-replicated execution AND the pre-extraction __graft_entry__
+    formulation (GSPMD scalar_reduce over NamedSharding inputs) on a
+    2-device CPU mesh.  Affine coordinates + infinity mask are compared
+    so the equality is over canonical field elements, not
+    representative-dependent Jacobian coordinates."""
+    from lodestar_tpu.ops.bls12_381 import curve as cv, fp, sharded, verify as dv
+    from lodestar_tpu.crypto.bls import curve as _oc
+
+    g = _oc.g1.to_affine(_oc.G1_GEN_JAC)
+    gx = jnp.asarray(fp.encode_int(g[0]))
+    gy = jnp.asarray(fp.encode_int(g[1]))
+    B = 4
+    pk_aff = (
+        jnp.broadcast_to(gx, (B,) + gx.shape),
+        jnp.broadcast_to(gy, (B,) + gy.shape),
+    )
+    pk_inf = jnp.zeros(B, bool)
+    active = jnp.ones(B, bool)
+    bits = cv.scalars_to_bits([3, 5, 7, 9], 4)
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("sp",))
+
+    # 1. the extracted production module's manual-collectives step
+    sharded_fn = jax.jit(sharded.build_reduced_step(mesh))
+    aff_s, inf_s = jax.device_get(sharded_fn(pk_aff, pk_inf, bits, active))
+
+    # 2. fully-replicated execution (no mesh at all)
+    def replicated(pk_aff, pk_inf, bits, active):
+        pk_jac = cv.from_affine(cv.F1, pk_aff, pk_inf | ~active)
+        rpk = cv.scalar_mul_bits(cv.F1, pk_jac, bits)
+        total = dv.jac_reduce_add(cv.F1, rpk)
+        return cv.to_affine(cv.F1, total, fp.inv)
+
+    aff_r, inf_r = jax.device_get(
+        jax.jit(replicated)(pk_aff, pk_inf, bits, active)
+    )
+
+    # 3. the pre-extraction __graft_entry__._dryrun_reduced formulation:
+    #    GSPMD jit + NamedSharding inputs, partitioner-inserted
+    #    collective, canonicalized to affine on the host
+    @jax.jit
+    def scalar_reduce(pk_aff, pk_inf, bits, active):
+        pk_jac = cv.from_affine(cv.F1, pk_aff, pk_inf | ~active)
+        rpk = cv.scalar_mul_bits(cv.F1, pk_jac, bits)
+        return dv.jac_reduce_add(cv.F1, rpk)
+
+    shard = NamedSharding(mesh, P("sp"))
+    args_sh = jax.tree.map(
+        lambda x: jax.device_put(x, shard), (pk_aff, pk_inf, bits, active)
+    )
+    jac_g = jax.device_get(scalar_reduce(*args_sh))
+    aff_g, inf_g = jax.device_get(
+        cv.to_affine(cv.F1, jax.tree.map(jnp.asarray, jac_g), fp.inv)
+    )
+
+    for name, (aff, inf) in {
+        "replicated": (aff_r, inf_r),
+        "graft-gspmd": (aff_g, inf_g),
+    }.items():
+        for x, y in zip(jax.tree.leaves(aff_s), jax.tree.leaves(aff)):
+            assert np.array_equal(x, y), f"sharded != {name} (affine limbs)"
+        assert np.array_equal(inf_s, inf), f"sharded != {name} (inf mask)"

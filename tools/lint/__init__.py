@@ -25,6 +25,7 @@ from . import rules_async, rules_jax, rules_repo  # noqa: F401  (registration)
 from . import rules_interproc  # noqa: F401  (registration)
 from . import rules_program  # noqa: F401  (registration: v3 whole-program)
 from . import rules_bounds  # noqa: F401  (registration: v4 limbcheck + contracts)
+from . import rules_shard  # noqa: F401  (registration: v5 shardcheck)
 from . import callgraph, effects  # noqa: F401  (public: graph/effect API)
 
 __all__ = [

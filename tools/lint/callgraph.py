@@ -56,6 +56,51 @@ _METRIC_OPS = {"inc", "dec", "observe", "set"}
 WIDTH_LOCAL_RE = re.compile(r"(?:^|_)(size|bucket|width)(?:_|$)")
 WIDTH_PARAM_RE = re.compile(r"(?:^|_)(bucket|width)(?:_|$)")
 
+# --- v5 shard/collective vocabulary (rules_shard.py consumes these) --------
+# jax.lax collectives whose axis-name argument must be bound by an
+# enclosing shard_map/pmap (collective-axis)
+COLLECTIVE_FUNCS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "psum_scatter", "ppermute", "axis_index",
+}
+# the subset that actually moves data across the axis — an out_specs=P()
+# (replicated) shard_map output must derive from one of these
+CROSS_AXIS_FUNCS = COLLECTIVE_FUNCS - {"axis_index"}
+_MESH_CTORS = {"Mesh", "AbstractMesh", "make_mesh"}
+# docstring contract: `@mesh: sp` / `@mesh: dp, tp` names the axis set a
+# mesh-parameterized function is written against (the static analogue of
+# the Mesh(...) construction the decorator's `mesh=` kwarg can't see)
+_MESH_CONTRACT_RE = re.compile(r"@mesh:\s*([A-Za-z_][A-Za-z0-9_]*(?:\s*,\s*[A-Za-z_][A-Za-z0-9_]*)*)")
+
+
+def parse_mesh_contract(doc: Optional[str]) -> List[str]:
+    """Axis names declared by a ``@mesh:`` docstring line, or []."""
+    if not doc:
+        return []
+    m = _MESH_CONTRACT_RE.search(doc)
+    if not m:
+        return []
+    return [a.strip() for a in m.group(1).split(",") if a.strip()]
+
+
+def _mesh_axes_of(node) -> Optional[List[str]]:
+    """Axis names of a ``Mesh(devices, ("sp",))`` / ``make_mesh(...,
+    axis_names=...)`` construction when they are static string literals."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+    if name not in _MESH_CTORS:
+        return None
+    axis_arg = node.args[1] if len(node.args) >= 2 else None
+    for kw in node.keywords:
+        if kw.arg == "axis_names":
+            axis_arg = kw.value
+    if axis_arg is None:
+        return None
+    if isinstance(axis_arg, ast.Constant) and isinstance(axis_arg.value, str):
+        return [axis_arg.value]
+    return _label_list(axis_arg)
+
 # call wrappers that schedule/await the coroutine they are handed — a
 # known-async call inside one of these is NOT an unawaited coroutine
 _CORO_WRAPPERS = {
@@ -239,6 +284,57 @@ def _label_list(node) -> Optional[List[str]]:
                 return None
         return out
     return None
+
+
+def _find_shard_call(dec: ast.AST) -> Optional[Tuple[str, ast.Call]]:
+    """(kind, call) when a decorator expresses a shard_map/pmap binding.
+
+    Recognized spellings (the repo uses all three):
+      ``@partial(jax.shard_map, mesh=..., ...)``
+      ``@lambda f: shard_map(f, mesh=..., ...)``   (and jax.pmap forms)
+      ``@shard_map(mesh=..., ...)`` / ``@jax.pmap(...)``
+    Any dotted name ENDING in ``shard_map`` matches, so a repo-local
+    version-compat wrapper (ops/bls12_381/sharded.py's ``shard_map``)
+    binds axes exactly like the jax primitive it wraps.
+    """
+    def classify(call: ast.Call) -> Optional[Tuple[str, ast.Call]]:
+        last = (dotted_name(call.func) or "").rsplit(".", 1)[-1]
+        if last.endswith("shard_map"):
+            return ("shard_map", call)
+        if last == "pmap":
+            return ("pmap", call)
+        if last == "partial" and call.args:
+            inner = (dotted_name(call.args[0]) or "").rsplit(".", 1)[-1]
+            if inner.endswith("shard_map"):
+                return ("shard_map", call)
+            if inner == "pmap":
+                return ("pmap", call)
+        return None
+
+    if isinstance(dec, ast.Call):
+        return classify(dec)
+    if isinstance(dec, ast.Lambda):
+        for sub in ast.walk(dec.body):
+            if isinstance(sub, ast.Call):
+                hit = classify(sub)
+                if hit:
+                    return hit
+    return None
+
+
+def _replicated_spec(node: ast.AST) -> bool:
+    """True when an out_specs expression declares a fully-replicated
+    output: a bare ``P()`` / ``PartitionSpec()`` call, or a tuple/list
+    whose every element is one."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return bool(node.elts) and all(_replicated_spec(e) for e in node.elts)
+    return (
+        isinstance(node, ast.Call)
+        and not node.args
+        and not node.keywords
+        and (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+        in ("P", "PartitionSpec")
+    )
 
 
 def walk_own(func: ast.AST) -> Iterable[ast.AST]:
@@ -531,6 +627,12 @@ class _Extractor(ast.NodeVisitor):
         # v4 whole-program raw material (fault-coverage / task-lifecycle)
         self.fault_fires: List[dict] = []
         self.fault_injects: List[dict] = []
+        # v5 shard/collective raw material (shardcheck)
+        self.module_meshes: Dict[str, List[str]] = {}  # name -> Mesh axis names
+        self.module_const_lines: Dict[str, int] = {}  # anchor for rung findings
+        self.mesh_contract: List[str] = []  # module docstring @mesh: axes
+        self._mesh_env: List[Dict[str, List[str]]] = []  # enclosing fn mesh locals
+        self._contract_env: List[List[str]] = []  # enclosing fn @mesh: contracts
 
     # -- imports ------------------------------------------------------
 
@@ -637,6 +739,7 @@ class _Extractor(ast.NodeVisitor):
         width_locals: List[dict] = []
         str_env: Dict[str, str] = dict(self.module_strs)
         jit_aliases: Set[str] = set()
+        local_meshes: Dict[str, List[str]] = {}  # locals bound to Mesh(...)
         own = list(walk_own(node))
 
         def _jit_ref(value) -> bool:
@@ -699,6 +802,9 @@ class _Extractor(ast.NodeVisitor):
                         str_env[t.id] = s
                     if _jit_ref(value):
                         jit_aliases.add(t.id)
+                    mesh_axes = _mesh_axes_of(value)
+                    if mesh_axes:
+                        local_meshes[t.id] = mesh_axes
                 elif (
                     isinstance(t, ast.Attribute)
                     and isinstance(t.value, ast.Name)
@@ -706,6 +812,11 @@ class _Extractor(ast.NodeVisitor):
                 ):
                     self._maybe_metric_def(t.attr, value, str_env)
 
+        own_contract = parse_mesh_contract(ast.get_docstring(node))
+        shard_decor = self._shard_decor(node, own_contract)
+        if shard_decor is not None and shard_decor.get("out_replicated"):
+            shard_decor["untainted_returns"] = self._untainted_returns(own)
+        collectives = self._collect_collectives(own, str_env)
         calls = self._collect_calls(own, canon, param_set, local_tags)
         metric_uses = self._collect_metric_uses(own)
         release_calls = self._collect_release_calls(node, own)
@@ -750,10 +861,17 @@ class _Extractor(ast.NodeVisitor):
                 "task_cancels": task_cancels,
                 "calls": calls,
                 "effects": effects,
+                "mesh_contract": own_contract,
+                "shard_decor": shard_decor,
+                "collectives": collectives,
             }
         )
         self.scope.append(("func", node.name))
+        self._mesh_env.append(local_meshes)
+        self._contract_env.append(own_contract)
         self.generic_visit(node)
+        self._contract_env.pop()
+        self._mesh_env.pop()
         self.scope.pop()
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
@@ -798,9 +916,13 @@ class _Extractor(ast.NodeVisitor):
             for e in value.elts
         ):
             ints = [e.value for e in value.elts]
+        mesh_axes = _mesh_axes_of(value)
         for name in names:
             if ints is not None:
                 self.module_consts[name] = ints
+                self.module_const_lines[name] = value.lineno
+            if mesh_axes:
+                self.module_meshes[name] = mesh_axes
             s = _const_str(value, self.module_strs)
             if s is not None:
                 self.module_strs[name] = s
@@ -907,6 +1029,154 @@ class _Extractor(ast.NodeVisitor):
                 }
             )
         return out
+
+    # -- v5 shard/collective raw material -----------------------------
+
+    def _shard_decor(self, node, own_contract: List[str]) -> Optional[dict]:
+        """The shard_map/pmap binding a function's decorator list
+        declares, with its bound axis names statically resolved.
+
+        Axis resolution order for a ``mesh=`` reference: an inline
+        ``Mesh(...)`` construction, a local of an enclosing function
+        assigned from ``Mesh(...)``, a module-level ``Mesh(...)``
+        binding, then ``@mesh:`` docstring contracts (own, enclosing,
+        module).  An unresolvable mesh leaves ``axes`` empty — the
+        collective-axis rule treats that as nothing bound, which is the
+        forcing function for carrying a ``@mesh:`` contract on
+        mesh-parameterized builders."""
+        for dec in node.decorator_list:
+            hit = _find_shard_call(dec)
+            if hit is None:
+                continue
+            kind, call = hit
+            rec: dict = {
+                "kind": kind, "line": dec.lineno, "axes": [],
+                "mesh_ref": None, "out_replicated": False,
+                "out_line": dec.lineno, "check_vma": None,
+                "check_vma_line": dec.lineno,
+            }
+            kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+            if kind == "pmap":
+                an = kwargs.get("axis_name")
+                if isinstance(an, ast.Constant) and isinstance(an.value, str):
+                    rec["axes"] = [an.value]
+                return rec
+            mesh_arg = kwargs.get("mesh")
+            axes: Optional[List[str]] = None
+            if mesh_arg is not None:
+                ref = dotted_name(mesh_arg)
+                rec["mesh_ref"] = ref
+                axes = _mesh_axes_of(mesh_arg)
+                if axes is None and ref:
+                    base = ref.split(".")[0]
+                    for env in reversed(self._mesh_env):
+                        if base in env:
+                            axes = env[base]
+                            break
+                    if axes is None:
+                        axes = self.module_meshes.get(base)
+            if axes:
+                rec["axes"] = list(axes)
+            else:
+                for contract in (
+                    [own_contract]
+                    + list(reversed(self._contract_env))
+                    + [self.mesh_contract]
+                ):
+                    if contract:
+                        rec["axes"] = list(contract)
+                        break
+            out = kwargs.get("out_specs")
+            if out is not None:
+                rec["out_replicated"] = _replicated_spec(out)
+                rec["out_line"] = out.lineno
+            for key in ("check_vma", "check_rep"):  # new / pre-0.6 kwarg name
+                if key in kwargs:
+                    v = kwargs[key]
+                    rec["check_vma_line"] = v.lineno
+                    if isinstance(v, ast.Constant) and isinstance(v.value, bool):
+                        rec["check_vma"] = v.value
+                    else:
+                        rec["check_vma"] = "dynamic"
+            return rec
+        return None
+
+    def _collect_collectives(
+        self, own: Sequence[ast.AST], str_env: Dict[str, str]
+    ) -> List[dict]:
+        """Collective call sites with their statically-resolved axis
+        names (``axes`` is None when the axis argument is not a string
+        literal/const — the rules under-approximate and skip those)."""
+        out: List[dict] = []
+        for n in own:
+            if not isinstance(n, ast.Call):
+                continue
+            last = (dotted_name(n.func) or "").rsplit(".", 1)[-1]
+            if last not in COLLECTIVE_FUNCS:
+                continue
+            axis_node = None
+            for kw in n.keywords:
+                if kw.arg == "axis_name":
+                    axis_node = kw.value
+            if axis_node is None:
+                pos = 0 if last == "axis_index" else 1
+                if len(n.args) > pos and not isinstance(n.args[pos], ast.Starred):
+                    axis_node = n.args[pos]
+            axes: Optional[List[str]] = None
+            if axis_node is not None:
+                s = _const_str(axis_node, str_env)
+                axes = [s] if s is not None else _label_list(axis_node)
+            out.append(
+                {"name": last, "axes": axes, "line": n.lineno, "col": n.col_offset}
+            )
+        return out
+
+    def _untainted_returns(self, own: Sequence[ast.AST]) -> List[List[int]]:
+        """Return sites NOT (transitively, through local names) derived
+        from a cross-axis collective — the replicated-escape raw
+        material.  Taint is name-level and flow-insensitive (iterated to
+        a fixpoint), matching the extractor's assignment-order
+        approximation elsewhere."""
+
+        def has_collective(expr: ast.AST) -> bool:
+            return any(
+                isinstance(sub, ast.Call)
+                and (dotted_name(sub.func) or "").rsplit(".", 1)[-1]
+                in CROSS_AXIS_FUNCS
+                for sub in ast.walk(expr)
+            )
+
+        def refs_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+            return any(
+                isinstance(sub, ast.Name) and sub.id in tainted
+                for sub in ast.walk(expr)
+            )
+
+        assigns = [
+            n for n in own
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+            and n.value is not None
+        ]
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for st in assigns:
+                if not (has_collective(st.value) or refs_tainted(st.value, tainted)):
+                    continue
+                targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name) and sub.id not in tainted:
+                            tainted.add(sub.id)
+                            changed = True
+        return sorted(
+            [n.lineno, n.col_offset]
+            for n in own
+            if isinstance(n, ast.Return)
+            and n.value is not None
+            and not (has_collective(n.value) or refs_tainted(n.value, tainted))
+        )
 
     def _collect_metric_uses(self, own: Sequence[ast.AST]) -> List[dict]:
         """Sites that touch a metric object: ``<chain>.labels(...)`` and
@@ -1054,6 +1324,7 @@ def extract_summary(
     module = module_name_for(path)
     ex = _Extractor(module, path)
     ex.ctx = module_effect_context(tree)
+    ex.mesh_contract = parse_mesh_contract(ast.get_docstring(tree))
     ex.visit(tree)
     per_line, per_file = (
         suppressions if suppressions is not None else parse_suppressions(text)
@@ -1065,6 +1336,9 @@ def extract_summary(
         "classes": ex.classes,
         "module_vars": ex.module_vars,
         "module_consts": ex.module_consts,
+        "module_const_lines": ex.module_const_lines,
+        "module_meshes": ex.module_meshes,
+        "mesh_contract": ex.mesh_contract,
         "jit_wrappers": ex.jit_wrappers,
         "metric_defs": ex.metric_defs,
         "release_defs": sorted(set(ex.release_defs)),

@@ -361,7 +361,10 @@ _CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".cache.j
 # cache must not feed the new rules empty fields
 # v3: v4-rule raw material (fault_fires/fault_injects, task_binds/
 # task_cancels, bounds_src for the limb-bound interpreter)
-_CACHE_VERSION = 3
+# v4: v5 shardcheck raw material (shard_map/pmap decorator bindings,
+# collective call sites with axis names, Mesh(...) axis tables,
+# @mesh: contracts, module-const anchor lines)
+_CACHE_VERSION = 4
 
 
 def _lint_stamp() -> str:
